@@ -1,0 +1,19 @@
+"""production_stack_tpu: a TPU-native LLM serving stack.
+
+Capability parity target: vllm-project/production-stack (KevinCheung2259 fork).
+Three planes, same as the reference (see SURVEY.md):
+
+  * data plane   -- an OpenAI-compatible L7 router (`production_stack_tpu.router`)
+                    proxying to a fleet of engine pods, with pluggable routing
+                    (round-robin / session-affinity / cache-aware load balancing).
+  * engine tier  -- unlike the reference (which launches external vLLM images,
+                    reference helm/templates/deployment-vllm-multi.yaml:58-134),
+                    the serving engine is IN-REPO and TPU-native: JAX/Pallas
+                    paged attention, paged HBM KV cache, continuous batching,
+                    tensor parallelism via jax.sharding over a TPU mesh
+                    (`production_stack_tpu.engine`, `.models`, `.ops`, `.parallel`).
+  * cache tier   -- KV offload HBM->host plus a remote shared KV cache server
+                    (`production_stack_tpu.cache`), the LMCache equivalent.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,4 @@
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.sampling import SamplingParams
+
+__all__ = ["EngineConfig", "SamplingParams"]

@@ -1,0 +1,65 @@
+"""Engine configuration.
+
+The reference configures its (external) engines through Helm values rendered
+into vLLM CLI flags (reference helm/templates/deployment-vllm-multi.yaml:60-134:
+--tensor-parallel-size, --max-model-len, --enable-prefix-caching, LMCACHE_*
+env). EngineConfig is the in-repo equivalent; the same knob names are kept
+where they exist so the chart stays recognizable.
+"""
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class EngineConfig:
+    model: str = "tiny-llama"
+    dtype: str = "bfloat16"
+    max_model_len: int = 2048
+    # --- KV cache ---
+    block_size: int = 16
+    num_kv_blocks: Optional[int] = None     # explicit block count; else derived
+    hbm_utilization: float = 0.9            # fraction of free HBM for KV pool
+    enable_prefix_caching: bool = True
+    # --- scheduler ---
+    max_num_seqs: int = 64
+    max_num_batched_tokens: int = 1024      # prefill chunk token budget
+    # --- parallelism (jax.sharding over the TPU slice mesh) ---
+    tensor_parallel_size: int = 1
+    sequence_parallel_size: int = 1         # ring-attention axis for long prefill
+    data_parallel_size: int = 1
+    # --- kernels ---
+    attn_impl: str = "auto"                 # "auto" | "xla" | "pallas"
+    # --- KV offload (LMCache-equivalent; env names mirror the reference chart)
+    kv_offload_cpu: bool = field(
+        default_factory=lambda: os.environ.get("LMCACHE_LOCAL_CPU", "").lower() == "true"
+    )
+    kv_offload_max_cpu_gb: float = field(
+        default_factory=lambda: float(os.environ.get("LMCACHE_MAX_LOCAL_CPU_SIZE", "0") or 0)
+    )
+    kv_remote_url: Optional[str] = field(
+        default_factory=lambda: os.environ.get("LMCACHE_REMOTE_URL") or None
+    )
+    kv_remote_serde: str = field(
+        default_factory=lambda: os.environ.get("LMCACHE_REMOTE_SERDE", "naive")
+    )
+    # --- weights ---
+    load_format: str = "auto"               # "auto" | "safetensors" | "dummy"
+    seed: int = 0
+    # --- serving ---
+    served_model_name: Optional[str] = None
+
+    def resolved_attn_impl(self) -> str:
+        if self.attn_impl != "auto":
+            return self.attn_impl
+        import jax
+        return "pallas" if jax.default_backend() not in ("cpu",) else "xla"
+
+    @property
+    def model_name(self) -> str:
+        return self.served_model_name or self.model
+
+    @property
+    def max_blocks_per_seq(self) -> int:
+        return -(-self.max_model_len // self.block_size)

@@ -1,0 +1,273 @@
+"""ServingEngine: the async continuous-batching inference engine.
+
+Owns: tokenizer, ModelRunner (device state + jitted step), BlockPoolManager
+(paged KV bookkeeping + prefix cache), Scheduler (continuous batching), and
+per-request output streams. The engine loop runs model steps in a worker
+thread so the asyncio event loop (HTTP serving) never blocks on the device.
+
+Aborts are DEFERRED: client disconnects enqueue the request id and the loop
+applies them between device steps — KV blocks are never freed while a step
+that writes into them is still in flight.
+
+This tier replaces the external vLLM engine images of the reference stack
+(reference helm/templates/deployment-vllm-multi.yaml:58-134).
+"""
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import AsyncIterator, Dict, List, Optional, Set
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.kv_cache import BlockPoolManager
+from production_stack_tpu.engine.runner import ModelRunner
+from production_stack_tpu.engine.sampling import SamplingParams
+from production_stack_tpu.engine.scheduler import (
+    Scheduler,
+    Sequence,
+    SequenceStatus,
+)
+from production_stack_tpu.engine.tokenizer import (
+    IncrementalDetokenizer,
+    get_tokenizer,
+)
+from production_stack_tpu.models.config import resolve_model_config
+from production_stack_tpu.parallel import make_mesh
+from production_stack_tpu.protocols import random_uuid
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+
+@dataclass
+class RequestOutput:
+    request_id: str
+    text_delta: str = ""
+    token_ids: List[int] = field(default_factory=list)
+    finished: bool = False
+    finish_reason: Optional[str] = None
+    num_prompt_tokens: int = 0
+    num_output_tokens: int = 0
+    num_cached_tokens: int = 0
+
+
+@dataclass
+class _StreamState:
+    queue: asyncio.Queue
+    detok: IncrementalDetokenizer
+    text: str = ""   # decoded output, already truncated at any stop match
+    sent: int = 0    # chars delivered to the client so far
+
+
+class ServingEngine:
+    def __init__(
+        self,
+        config: EngineConfig,
+        mesh=None,
+        params=None,
+        num_kv_blocks: Optional[int] = None,
+    ):
+        self.config = config
+        self.model_config = resolve_model_config(config.model)
+        self.tokenizer = get_tokenizer(config.model, self.model_config)
+        self.mesh = mesh or make_mesh(
+            dp=config.data_parallel_size,
+            sp=config.sequence_parallel_size,
+            tp=config.tensor_parallel_size,
+        )
+        self.runner = ModelRunner(
+            config, self.model_config, self.mesh,
+            params=params, num_kv_blocks=num_kv_blocks,
+        )
+        self.block_manager = BlockPoolManager(
+            self.runner.num_kv_blocks, config.block_size,
+            config.enable_prefix_caching,
+        )
+        self.scheduler = Scheduler(config, self.block_manager)
+
+        self._streams: Dict[str, _StreamState] = {}
+        self._pending_aborts: Set[str] = set()
+        self._step_counter = 0
+        self._new_work = asyncio.Event()
+        self._loop_task: Optional[asyncio.Task] = None
+        self._running = False
+        # telemetry
+        self.start_time = time.monotonic()
+        self.prompt_tokens_total = 0
+        self.generation_tokens_total = 0
+        self.offload_blocks_resident = 0
+        self.last_step_time = time.monotonic()
+
+    # --------------------------------------------------------------- lifecycle
+    async def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        self._loop_task = asyncio.create_task(self._run_loop())
+        logger.info(
+            "Engine started: model=%s kv_blocks=%d block_size=%d attn=%s mesh=%s",
+            self.config.model_name, self.runner.num_kv_blocks,
+            self.config.block_size, self.runner.attn_impl,
+            dict(self.mesh.shape),
+        )
+
+    async def stop(self) -> None:
+        self._running = False
+        self._new_work.set()
+        if self._loop_task:
+            await self._loop_task
+            self._loop_task = None
+
+    @property
+    def is_healthy(self) -> bool:
+        return self._running and (
+            self._loop_task is not None and not self._loop_task.done()
+        )
+
+    # ----------------------------------------------------------------- intake
+    async def generate(
+        self,
+        prompt: Optional[str] = None,
+        prompt_token_ids: Optional[List[int]] = None,
+        sampling: Optional[SamplingParams] = None,
+        request_id: Optional[str] = None,
+    ) -> AsyncIterator[RequestOutput]:
+        """Submit a request; yields streaming RequestOutput deltas."""
+        request_id = request_id or random_uuid("req-")
+        sampling = sampling or SamplingParams()
+        if prompt_token_ids is None:
+            assert prompt is not None
+            prompt_token_ids = self.tokenizer.encode(prompt)
+        if not prompt_token_ids:
+            prompt_token_ids = [self.tokenizer.eos_token_id or 0]
+        seq = Sequence(
+            request_id=request_id,
+            prompt_token_ids=list(prompt_token_ids),
+            sampling=sampling,
+            eos_token_id=self.tokenizer.eos_token_id,
+        )
+        state = _StreamState(
+            queue=asyncio.Queue(), detok=IncrementalDetokenizer(self.tokenizer)
+        )
+        self._streams[request_id] = state
+        self.scheduler.add_sequence(seq)
+        self.prompt_tokens_total += len(prompt_token_ids)
+        self._new_work.set()
+        try:
+            while True:
+                out: RequestOutput = await state.queue.get()
+                yield out
+                if out.finished:
+                    break
+        finally:
+            self._streams.pop(request_id, None)
+            if not seq.status.is_finished:
+                self.abort(request_id)
+
+    def abort(self, request_id: str) -> None:
+        """Deferred abort: applied by the engine loop between device steps."""
+        self._pending_aborts.add(request_id)
+        self._new_work.set()
+
+    # ------------------------------------------------------------ engine loop
+    async def _run_loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        while self._running:
+            self._apply_pending_aborts()
+            batch = self.scheduler.schedule()
+            if batch is None:
+                self._new_work.clear()
+                if not self.scheduler.has_work():
+                    try:
+                        await asyncio.wait_for(self._new_work.wait(), timeout=1.0)
+                    except asyncio.TimeoutError:
+                        pass
+                else:
+                    # Work exists but nothing schedulable (pool starved by
+                    # in-flight requests) — yield and retry.
+                    await asyncio.sleep(0.001)
+                continue
+            step = self._step_counter
+            self._step_counter += 1
+            try:
+                next_tokens = await loop.run_in_executor(
+                    None, self.runner.execute, batch, step
+                )
+            except Exception:  # noqa: BLE001 — engine loop must survive
+                logger.exception("Model step failed; aborting batch")
+                for seq in batch.seqs:
+                    aborted = self.scheduler.abort(seq.request_id)
+                    if aborted is not None:
+                        self._process_output(aborted)
+                continue
+            self.last_step_time = time.monotonic()
+            produced = self.scheduler.update_after_step(batch, next_tokens)
+            self.generation_tokens_total += len(produced)
+            for seq in produced:
+                self._process_output(seq)
+            await asyncio.sleep(0)
+
+    def _apply_pending_aborts(self) -> None:
+        while self._pending_aborts:
+            rid = self._pending_aborts.pop()
+            seq = self.scheduler.abort(rid)
+            if seq is not None:
+                self._process_output(seq)
+
+    # ------------------------------------------------------------- emissions
+    def _process_output(self, seq: Sequence) -> None:
+        """Detokenize incrementally, apply stop-string semantics, emit delta.
+
+        OpenAI contract: the stop sequence itself is EXCLUDED from the output.
+        While a request has stop strings, the last len(longest_stop)-1 chars
+        are held back so a stop match split across token boundaries is never
+        partially delivered.
+        """
+        st = self._streams.get(seq.request_id)
+        if st is None:
+            return
+        finished = seq.status.is_finished
+        delta = st.detok.step(seq.output_token_ids, flush=finished)
+        st.text += delta
+        stops = seq.sampling.stop
+        if stops and delta and not finished:
+            max_stop = max(len(s) for s in stops)
+            start = max(0, len(st.text) - len(delta) - max_stop)
+            idx = -1
+            for s in stops:
+                i = st.text.find(s, start)
+                if i != -1 and (idx == -1 or i < idx):
+                    idx = i
+            if idx != -1:
+                st.text = st.text[:idx]
+                self.scheduler.finish(
+                    seq.request_id, SequenceStatus.FINISHED_STOPPED
+                )
+                finished = True
+        hold = 0 if finished or not stops else max(len(s) for s in stops) - 1
+        emit_upto = max(len(st.text) - hold, st.sent)
+        text_delta = st.text[st.sent:emit_upto]
+        st.sent = emit_upto
+        st.queue.put_nowait(RequestOutput(
+            request_id=seq.request_id,
+            text_delta=text_delta,
+            token_ids=list(seq.output_token_ids),
+            finished=finished,
+            finish_reason=seq.finish_reason(),
+            num_prompt_tokens=seq.num_prompt_tokens,
+            num_output_tokens=len(seq.output_token_ids),
+            num_cached_tokens=seq.num_cached_tokens,
+        ))
+
+    # ------------------------------------------------------------------ stats
+    def stats(self) -> Dict:
+        return {
+            "num_requests_running": self.scheduler.num_running,
+            "num_requests_waiting": self.scheduler.num_waiting,
+            "kv_cache_usage": self.block_manager.usage(),
+            "prefix_cache_hits": self.block_manager.prefix_hits_total,
+            "prefix_cache_queries": self.block_manager.prefix_queries_total,
+            "num_preemptions": self.scheduler.num_preemptions_total,
+            "prompt_tokens_total": self.prompt_tokens_total,
+            "generation_tokens_total": self.generation_tokens_total,
+        }

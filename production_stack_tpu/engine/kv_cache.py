@@ -1,0 +1,196 @@
+"""Paged KV cache block manager with hash-based prefix caching.
+
+This is host-side bookkeeping for the device-side KV slot pools
+([L, num_blocks*block_size, Hkv, Dh] jax arrays owned by the ModelRunner).
+It replaces the paged-KV + prefix-cache machinery of the reference's external
+vLLM images, and emits the counters the reference router's scraper contract
+requires (reference src/vllm_router/stats/engine_stats.py:128-155:
+vllm:gpu_prefix_cache_hits_total / queries_total / gpu_cache_usage_perc).
+
+Design:
+  * Block 0 is the reserved null block (padding writes land there).
+  * Full blocks are content-addressed: hash chain H(prev, tokens) -> block id.
+  * Freed blocks that carry a hash go into an evictable LRU ("cached-free");
+    they are resurrected on prefix hit or reclaimed (LRU) when the free list
+    runs dry — KV stays warm across requests exactly like vLLM's prefix cache.
+  * Copy-on-write is avoided by construction: shared (ref_count > 1 or cached)
+    blocks are always FULL; writes only ever target a sequence's private tail
+    block.
+"""
+
+import hashlib
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+
+def _block_hash(prev: bytes, tokens: Sequence[int]) -> bytes:
+    h = hashlib.blake2b(digest_size=16)
+    h.update(prev)
+    h.update(b"|")
+    h.update(",".join(map(str, tokens)).encode())
+    return h.digest()
+
+
+class BlockPoolManager:
+    def __init__(self, num_blocks: int, block_size: int,
+                 enable_prefix_caching: bool = True):
+        assert num_blocks >= 2, "need at least null block + one usable block"
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.enable_prefix_caching = enable_prefix_caching
+        # Block 0 reserved as null.
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._ref: Dict[int, int] = {}
+        # content hash -> block id (full blocks only)
+        self._hash_to_block: Dict[bytes, int] = {}
+        self._block_to_hash: Dict[int, bytes] = {}
+        # evictable: blocks with ref 0 still holding cached content (LRU order)
+        self._evictable: "OrderedDict[int, None]" = OrderedDict()
+        # prefix-cache counters (token granularity, monotonic)
+        self.prefix_queries_total = 0
+        self.prefix_hits_total = 0
+
+    # ------------------------------------------------------------- accounting
+    @property
+    def num_free_blocks(self) -> int:
+        return len(self._free) + len(self._evictable)
+
+    @property
+    def num_used_blocks(self) -> int:
+        return (self.num_blocks - 1) - self.num_free_blocks
+
+    def usage(self) -> float:
+        usable = self.num_blocks - 1
+        return self.num_used_blocks / usable if usable else 0.0
+
+    # ------------------------------------------------------------- allocation
+    def _pop_free_block(self) -> Optional[int]:
+        if self._free:
+            return self._free.pop()
+        if self._evictable:
+            # Reclaim least-recently-used cached block.
+            blk, _ = self._evictable.popitem(last=False)
+            h = self._block_to_hash.pop(blk, None)
+            if h is not None:
+                self._hash_to_block.pop(h, None)
+            return blk
+        return None
+
+    def can_allocate(self, n: int) -> bool:
+        return self.num_free_blocks >= n
+
+    def allocate_blocks(self, n: int) -> Optional[List[int]]:
+        if not self.can_allocate(n):
+            return None
+        out = []
+        for _ in range(n):
+            blk = self._pop_free_block()
+            assert blk is not None
+            self._ref[blk] = 1
+            out.append(blk)
+        return out
+
+    def lookup_prefix(self, token_ids: Sequence[int]) -> Tuple[List[int], int]:
+        """Find the longest cached full-block prefix of ``token_ids``.
+
+        Returns (cached_block_ids, num_cached_tokens). Does NOT take refs and
+        does NOT touch the hit/query counters; pair with ``allocate_prompt``.
+        At least one prompt token is always left uncached so prefill has a
+        position to compute logits from.
+        """
+        if not self.enable_prefix_caching:
+            return [], 0
+        # Leave >= 1 token to recompute.
+        max_cached_tokens = len(token_ids) - 1
+        usable_full_blocks = max_cached_tokens // self.block_size
+        blocks: List[int] = []
+        prev = b""
+        for i in range(usable_full_blocks):
+            chunk = token_ids[i * self.block_size:(i + 1) * self.block_size]
+            h = _block_hash(prev, chunk)
+            blk = self._hash_to_block.get(h)
+            if blk is None:
+                break
+            blocks.append(blk)
+            prev = h
+        return blocks, len(blocks) * self.block_size
+
+    def allocate_prompt(
+        self, token_ids: Sequence[int]
+    ) -> Optional[Tuple[List[int], int]]:
+        """Allocate the block table for a new prompt, reusing cached prefixes.
+
+        Returns (block_ids, num_cached_tokens) or None if out of blocks.
+        """
+        if self.num_free_blocks == 0:
+            return None  # cheap out: don't hash the prompt on a starved pool
+        cached, n_cached = self.lookup_prefix(token_ids)
+        total_blocks = -(-len(token_ids) // self.block_size)
+        n_new = total_blocks - len(cached)
+        # Pin the cached blocks FIRST: reviving an evictable block shrinks the
+        # free count, and an unpinned cached block could otherwise be evicted
+        # out from under us by allocate_blocks itself.
+        for blk in cached:
+            self._take_ref(blk)
+        fresh = self.allocate_blocks(n_new)
+        if fresh is None:
+            self.free_blocks(cached)  # roll back the pins
+            return None
+        # Count hit/query telemetry only for ADMITTED prompts, so retry loops
+        # on a congested pool don't inflate the hit rate the router scrapes.
+        self.prefix_queries_total += len(token_ids)
+        self.prefix_hits_total += n_cached
+        return cached + fresh, n_cached
+
+    def append_block(self) -> Optional[int]:
+        blocks = self.allocate_blocks(1)
+        return blocks[0] if blocks else None
+
+    def _take_ref(self, blk: int) -> None:
+        if blk in self._evictable:
+            del self._evictable[blk]
+            self._ref[blk] = 1
+        else:
+            self._ref[blk] = self._ref.get(blk, 0) + 1
+
+    # ----------------------------------------------------------- registration
+    def register_full_block(
+        self, blk: int, prev_hash: bytes, tokens: Sequence[int]
+    ) -> bytes:
+        """Content-address a block that just became full (prefill or decode)."""
+        if not self.enable_prefix_caching:
+            return b""
+        h = _block_hash(prev_hash, tokens)
+        existing = self._hash_to_block.get(h)
+        if existing is not None and existing != blk:
+            # Duplicate content raced in; keep the earlier block as canonical.
+            return h
+        self._hash_to_block[h] = blk
+        self._block_to_hash[blk] = h
+        return h
+
+    # ----------------------------------------------------------------- free
+    def free_blocks(self, blocks: Sequence[int]) -> None:
+        for blk in blocks:
+            ref = self._ref.get(blk, 0) - 1
+            if ref > 0:
+                self._ref[blk] = ref
+                continue
+            self._ref.pop(blk, None)
+            if blk in self._block_to_hash:
+                self._evictable[blk] = None
+                self._evictable.move_to_end(blk)
+            else:
+                self._free.append(blk)
+
+    def reset_prefix_cache(self) -> None:
+        for blk in list(self._evictable):
+            self._free.append(blk)
+            h = self._block_to_hash.pop(blk, None)
+            if h is not None:
+                self._hash_to_block.pop(h, None)
+        self._evictable.clear()

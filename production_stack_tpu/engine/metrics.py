@@ -1,0 +1,66 @@
+"""Engine /metrics exposition.
+
+Emits the EXACT series names the reference router's scraper parses
+(reference src/vllm_router/stats/engine_stats.py:128-155):
+  vllm:num_requests_running, vllm:num_requests_waiting,
+  vllm:gpu_prefix_cache_hits_total, vllm:gpu_prefix_cache_queries_total,
+  vllm:gpu_cache_usage_perc  — reinterpreted as TPU **HBM** KV-pool usage.
+
+Implemented as a prometheus_client custom Collector reading live engine
+state at scrape time (no sampling thread, no drift between gauges).
+"""
+
+import time
+from typing import TYPE_CHECKING, Iterable
+
+from prometheus_client.core import CounterMetricFamily, GaugeMetricFamily
+from prometheus_client.registry import Collector
+
+if TYPE_CHECKING:
+    from production_stack_tpu.engine.engine import ServingEngine
+
+
+class EngineMetricsCollector(Collector):
+    def __init__(self, engine: "ServingEngine"):
+        self.engine = engine
+
+    def collect(self) -> Iterable:
+        eng = self.engine
+        labels = ["model_name"]
+        lv = [eng.config.model_name]
+
+        def gauge(name, doc, value):
+            g = GaugeMetricFamily(name, doc, labels=labels)
+            g.add_metric(lv, value)
+            return g
+
+        def counter(name, doc, value):
+            # prometheus_client appends _total to CounterMetricFamily names.
+            assert name.endswith("_total")
+            c = CounterMetricFamily(name[: -len("_total")], doc, labels=labels)
+            c.add_metric(lv, value)
+            return c
+
+        sched = eng.scheduler
+        bm = eng.block_manager
+        yield gauge("vllm:num_requests_running",
+                    "Number of requests currently decoding", sched.num_running)
+        yield gauge("vllm:num_requests_waiting",
+                    "Number of requests waiting for prefill", sched.num_waiting)
+        yield gauge("vllm:gpu_cache_usage_perc",
+                    "KV pool usage fraction (TPU HBM)", bm.usage())
+        yield counter("vllm:gpu_prefix_cache_hits_total",
+                      "Prefix cache hit tokens", bm.prefix_hits_total)
+        yield counter("vllm:gpu_prefix_cache_queries_total",
+                      "Prefix cache queried tokens", bm.prefix_queries_total)
+        yield counter("vllm:num_preemptions_total",
+                      "Sequences preempted", sched.num_preemptions_total)
+        yield counter("vllm:prompt_tokens_total",
+                      "Prefilled tokens", eng.prompt_tokens_total)
+        yield counter("vllm:generation_tokens_total",
+                      "Generated tokens", eng.generation_tokens_total)
+        yield gauge("pstpu:engine_uptime_seconds",
+                    "Engine uptime", time.monotonic() - eng.start_time)
+        yield gauge("pstpu:kv_offload_blocks",
+                    "KV blocks resident in the host offload pool",
+                    eng.offload_blocks_resident)

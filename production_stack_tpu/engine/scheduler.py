@@ -1,0 +1,313 @@
+"""Continuous-batching scheduler.
+
+Replaces the continuous-batching scheduler of the reference's external vLLM
+engines (SURVEY.md §2.2). Policy (vLLM-v0-style, TPU-shaped):
+
+  * Prefill has priority: a waiting request is admitted and prefilled in
+    token-budgeted CHUNKS (one sequence per prefill step keeps the compiled
+    shape family small: [1, T_bucket]).
+  * Otherwise all RUNNING sequences decode together in one [B_bucket, 1] step.
+  * Preemption by recompute: when the block pool is exhausted, the
+    lowest-priority running sequence is evicted (blocks freed, KV optionally
+    spilled to the host offload pool) and re-queued at the front of WAITING.
+
+The prefill/decode distinction is observable by the router's request-stats
+plane (reference src/vllm_router/stats/request_stats.py:119-121), so it is
+load-bearing, not an implementation detail.
+"""
+
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional, Sequence as Seq
+from collections import deque
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.kv_cache import BlockPoolManager
+from production_stack_tpu.engine.sampling import SamplingParams
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+
+class SequenceStatus(enum.Enum):
+    WAITING = "waiting"
+    RUNNING = "running"
+    FINISHED_STOPPED = "stop"
+    FINISHED_LENGTH = "length"
+    FINISHED_ABORTED = "abort"
+
+    @property
+    def is_finished(self) -> bool:
+        return self in (
+            SequenceStatus.FINISHED_STOPPED,
+            SequenceStatus.FINISHED_LENGTH,
+            SequenceStatus.FINISHED_ABORTED,
+        )
+
+
+@dataclass
+class Sequence:
+    request_id: str
+    prompt_token_ids: List[int]
+    sampling: SamplingParams
+    eos_token_id: Optional[int] = None
+    arrival_time: float = field(default_factory=time.monotonic)
+
+    status: SequenceStatus = SequenceStatus.WAITING
+    output_token_ids: List[int] = field(default_factory=list)
+    block_ids: List[int] = field(default_factory=list)
+    num_computed_tokens: int = 0       # tokens whose KV is in the device pool
+    num_cached_tokens: int = 0         # prefix-cache hits (telemetry)
+    num_preemptions: int = 0
+    first_token_time: Optional[float] = None
+    # prefix-cache hash chain bookkeeping
+    _prev_hash: bytes = b""
+    _num_hashed_blocks: int = 0
+
+    @property
+    def all_token_ids(self) -> List[int]:
+        return self.prompt_token_ids + self.output_token_ids
+
+    @property
+    def num_tokens(self) -> int:
+        return len(self.prompt_token_ids) + len(self.output_token_ids)
+
+    @property
+    def num_prompt_tokens(self) -> int:
+        return len(self.prompt_token_ids)
+
+    @property
+    def prefill_done(self) -> bool:
+        return self.num_computed_tokens >= len(self.prompt_token_ids)
+
+    def finish_reason(self) -> Optional[str]:
+        return self.status.value if self.status.is_finished else None
+
+
+@dataclass
+class ScheduledBatch:
+    kind: str                        # "prefill" | "decode"
+    seqs: List[Sequence]
+    chunk_starts: List[int] = field(default_factory=list)  # prefill only
+    chunk_lens: List[int] = field(default_factory=list)
+
+    @property
+    def num_tokens(self) -> int:
+        return sum(self.chunk_lens) if self.kind == "prefill" else len(self.seqs)
+
+
+class Scheduler:
+    def __init__(self, config: EngineConfig, block_manager: BlockPoolManager):
+        self.config = config
+        self.block_manager = block_manager
+        self.waiting: Deque[Sequence] = deque()
+        self.running: List[Sequence] = []
+        self.seqs: Dict[str, Sequence] = {}
+        self.num_preemptions_total = 0
+
+    # ----------------------------------------------------------------- intake
+    def add_sequence(self, seq: Sequence) -> None:
+        if seq.num_tokens > self.config.max_model_len:
+            raise ValueError(
+                f"Prompt of {seq.num_tokens} tokens exceeds max_model_len "
+                f"{self.config.max_model_len}"
+            )
+        bs = self.config.block_size
+        usable = self.block_manager.num_blocks - 1
+        if -(-seq.num_tokens // bs) > usable:
+            raise ValueError(
+                f"Prompt of {seq.num_tokens} tokens cannot fit the KV pool "
+                f"({usable} blocks x {bs} tokens)"
+            )
+        self.seqs[seq.request_id] = seq
+        self.waiting.append(seq)
+
+    def abort(self, request_id: str) -> Optional[Sequence]:
+        return self.finish(request_id, SequenceStatus.FINISHED_ABORTED)
+
+    def finish(self, request_id: str, status: SequenceStatus) -> Optional[Sequence]:
+        """Externally finish a request (abort, or stop-string match detected
+        by the engine's detokenizer)."""
+        seq = self.seqs.get(request_id)
+        if seq is None or seq.status.is_finished:
+            return None
+        self._finish(seq, status)
+        return seq
+
+    @property
+    def num_waiting(self) -> int:
+        return len(self.waiting)
+
+    @property
+    def num_running(self) -> int:
+        return len(self.running)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    # -------------------------------------------------------------- schedule
+    def schedule(self) -> Optional[ScheduledBatch]:
+        batch = self._try_schedule_prefill()
+        if batch is not None:
+            return batch
+        return self._schedule_decode()
+
+    def _try_schedule_prefill(self) -> Optional[ScheduledBatch]:
+        if not self.waiting or len(self.running) >= self.config.max_num_seqs:
+            return None
+        # Mostly-FCFS scan: prefer the queue head, but skip past prompts that
+        # cannot get blocks yet so a mid-prefill sequence deeper in the queue
+        # (which already holds blocks) can still make progress — otherwise a
+        # starved head could deadlock the pool.
+        seq = None
+        for cand in self.waiting:
+            if cand.block_ids:
+                seq = cand
+                break
+            # Prefill NEVER preempts: a waiting prompt simply waits for blocks
+            # to free up. Preempting here admits ping-pong livelock (two
+            # starved prompts evicting each other); only decode slot-appends
+            # preempt, which preserves FCFS progress.
+            alloc = self.block_manager.allocate_prompt(cand.all_token_ids)
+            if alloc is not None:
+                cand.block_ids, cand.num_cached_tokens = alloc
+                cand.num_computed_tokens = cand.num_cached_tokens
+                seq = cand
+                break
+        if seq is None:
+            return None
+        self.waiting.remove(seq)
+        start = seq.num_computed_tokens
+        # NOTE: a preempted sequence re-prefills prompt+output together.
+        chunk = min(
+            self.config.max_num_batched_tokens, seq.num_tokens - start
+        )
+        seq.status = SequenceStatus.RUNNING
+        return ScheduledBatch(
+            kind="prefill", seqs=[seq], chunk_starts=[start], chunk_lens=[chunk]
+        )
+
+    def _schedule_decode(self) -> Optional[ScheduledBatch]:
+        if not self.running:
+            return None
+        scheduled: List[Sequence] = []
+        for seq in list(self.running):
+            if seq not in self.running:
+                # Preempted by an earlier iteration of this same pass.
+                continue
+            # Position being written this step:
+            pos = seq.num_computed_tokens
+            need_blocks = pos // self.config.block_size + 1
+            while len(seq.block_ids) < need_blocks:
+                blk = self.block_manager.append_block()
+                if blk is None:
+                    victim = self._pick_preemption_victim(exclude=scheduled)
+                    if victim is None or victim is seq:
+                        # Cannot make space without killing `seq` itself;
+                        # preempt seq and stop scheduling it this step.
+                        self._preempt(seq)
+                        break
+                    self._preempt(victim)
+                    continue
+                seq.block_ids.append(blk)
+            else:
+                scheduled.append(seq)
+        if not scheduled:
+            return None
+        return ScheduledBatch(kind="decode", seqs=scheduled)
+
+    def _pick_preemption_victim(self, exclude: Seq[Sequence]) -> Optional[Sequence]:
+        for seq in reversed(self.running):
+            if seq not in exclude:
+                return seq
+        return None
+
+    def _preempt(self, seq: Sequence) -> None:
+        logger.warning("Preempting request %s (recompute)", seq.request_id)
+        self.num_preemptions_total += 1
+        seq.num_preemptions += 1
+        if seq in self.running:
+            self.running.remove(seq)
+        self.block_manager.free_blocks(seq.block_ids)
+        seq.block_ids = []
+        seq.num_computed_tokens = 0
+        seq._prev_hash = b""
+        seq._num_hashed_blocks = 0
+        seq.status = SequenceStatus.WAITING
+        self.waiting.appendleft(seq)
+
+    # ------------------------------------------------------- post-step update
+    def update_after_step(
+        self, batch: ScheduledBatch, next_tokens: List[int]
+    ) -> List[Sequence]:
+        """Apply model outputs; returns sequences that produced a NEW token."""
+        produced: List[Sequence] = []
+        if batch.kind == "prefill":
+            seq = batch.seqs[0]
+            if seq.status.is_finished:
+                return produced  # aborted while the step was in flight
+            seq.num_computed_tokens += batch.chunk_lens[0]
+            self._register_full_blocks(seq)
+            if seq.num_computed_tokens >= seq.num_tokens:
+                # Prefill complete: the sampled token is the next real token.
+                self._append_token(seq, next_tokens[0])
+                produced.append(seq)
+                self.running.append(seq)
+            else:
+                # More chunks to go; requeue at the front.
+                seq.status = SequenceStatus.WAITING
+                self.waiting.appendleft(seq)
+        else:
+            for seq, tok in zip(batch.seqs, next_tokens):
+                if seq.status.is_finished:
+                    continue  # aborted while the step was in flight
+                seq.num_computed_tokens += 1
+                self._register_full_blocks(seq)
+                self._append_token(seq, tok)
+                produced.append(seq)
+        for seq in produced:
+            if seq.status.is_finished and seq in self.running:
+                self.running.remove(seq)
+        return produced
+
+    def _append_token(self, seq: Sequence, token: int) -> None:
+        if seq.first_token_time is None:
+            seq.first_token_time = time.monotonic()
+        seq.output_token_ids.append(token)
+        sp = seq.sampling
+        n_out = len(seq.output_token_ids)
+        if (
+            not sp.ignore_eos
+            and n_out >= sp.min_tokens
+            and (
+                (seq.eos_token_id is not None and token == seq.eos_token_id)
+                or token in sp.stop_token_ids
+            )
+        ):
+            self._finish(seq, SequenceStatus.FINISHED_STOPPED)
+        elif n_out >= sp.max_tokens or seq.num_tokens >= self.config.max_model_len:
+            self._finish(seq, SequenceStatus.FINISHED_LENGTH)
+
+    def _finish(self, seq: Sequence, status: SequenceStatus) -> None:
+        seq.status = status
+        if seq in self.running:
+            self.running.remove(seq)
+        if seq in self.waiting:
+            self.waiting.remove(seq)
+        self.block_manager.free_blocks(seq.block_ids)
+        seq.block_ids = []
+
+    def _register_full_blocks(self, seq: Sequence) -> None:
+        if not seq.block_ids:
+            return  # freed (abort/preempt) before this bookkeeping ran
+        bs = self.config.block_size
+        full = seq.num_computed_tokens // bs
+        tokens = seq.all_token_ids
+        while seq._num_hashed_blocks < full:
+            i = seq._num_hashed_blocks
+            h = self.block_manager.register_full_block(
+                seq.block_ids[i], seq._prev_hash, tokens[i * bs:(i + 1) * bs]
+            )
+            seq._prev_hash = h
+            seq._num_hashed_blocks += 1

@@ -1,0 +1,28 @@
+"""Model registry: arch name -> (init_params, forward, compute_logits)."""
+
+from production_stack_tpu.models import llama, opt
+from production_stack_tpu.models.config import (
+    LLAMA3_8B,
+    NAMED_CONFIGS,
+    OPT_125M,
+    TINY_LLAMA,
+    ModelConfig,
+    resolve_model_config,
+)
+
+_ARCHS = {
+    "llama": (llama.init_params, llama.forward, llama.compute_logits),
+    "opt": (opt.init_params, opt.forward, opt.compute_logits),
+}
+
+
+def get_model_fns(cfg: ModelConfig):
+    if cfg.arch not in _ARCHS:
+        raise ValueError(f"Unknown arch {cfg.arch!r}; available: {list(_ARCHS)}")
+    return _ARCHS[cfg.arch]
+
+
+__all__ = [
+    "ModelConfig", "resolve_model_config", "get_model_fns",
+    "NAMED_CONFIGS", "TINY_LLAMA", "OPT_125M", "LLAMA3_8B",
+]

@@ -1,0 +1,129 @@
+"""Model architecture config.
+
+One config dataclass covers the supported decoder-only families:
+  * ``llama`` — Llama/Llama-2/Llama-3, Mistral, Qwen2 (RMSNorm + RoPE + SwiGLU,
+    optional GQA, optional attention bias for Qwen2).
+  * ``opt``   — OPT-style (LayerNorm + learned positions + GELU MLP), used for
+    the tiny parity configs (facebook/opt-125m in the reference's
+    values-01-minimal-example, see BASELINE.json).
+
+The reference stack never defines models in-repo (it launches external vLLM
+images, reference helm/templates/deployment-vllm-multi.yaml:58-134); here the
+model tier is in-repo and TPU-native.
+"""
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    arch: str = "llama"  # "llama" | "opt"
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: Optional[int] = None
+    max_position_embeddings: int = 4096
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    tie_word_embeddings: bool = False
+    attention_bias: bool = False  # Qwen2-style qkv bias
+    dtype: str = "bfloat16"
+    name: str = "model"
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def q_per_kv(self) -> int:
+        assert self.num_heads % self.num_kv_heads == 0
+        return self.num_heads // self.num_kv_heads
+
+    @staticmethod
+    def from_hf_config(d: dict, name: str = "model") -> "ModelConfig":
+        """Map a HuggingFace config.json dict onto ModelConfig."""
+        model_type = d.get("model_type", "llama")
+        if model_type in ("llama", "mistral", "qwen2"):
+            return ModelConfig(
+                arch="llama",
+                vocab_size=d["vocab_size"],
+                hidden_size=d["hidden_size"],
+                intermediate_size=d["intermediate_size"],
+                num_layers=d["num_hidden_layers"],
+                num_heads=d["num_attention_heads"],
+                num_kv_heads=d.get("num_key_value_heads", d["num_attention_heads"]),
+                head_dim=d.get("head_dim"),
+                max_position_embeddings=d.get("max_position_embeddings", 4096),
+                rope_theta=d.get("rope_theta", 10000.0),
+                rms_norm_eps=d.get("rms_norm_eps", 1e-5),
+                tie_word_embeddings=d.get("tie_word_embeddings", False),
+                attention_bias=model_type == "qwen2" or d.get("attention_bias", False),
+                name=name,
+            )
+        if model_type == "opt":
+            return ModelConfig(
+                arch="opt",
+                vocab_size=d["vocab_size"],
+                hidden_size=d["hidden_size"],
+                intermediate_size=d.get("ffn_dim", 4 * d["hidden_size"]),
+                num_layers=d["num_hidden_layers"],
+                num_heads=d["num_attention_heads"],
+                num_kv_heads=d["num_attention_heads"],
+                max_position_embeddings=d.get("max_position_embeddings", 2048),
+                tie_word_embeddings=d.get("tie_word_embeddings", True),
+                name=name,
+            )
+        raise ValueError(f"Unsupported model_type: {model_type}")
+
+    @staticmethod
+    def from_pretrained_dir(path: str, name: Optional[str] = None) -> "ModelConfig":
+        with open(os.path.join(path, "config.json")) as f:
+            return ModelConfig.from_hf_config(json.load(f), name=name or path)
+
+
+# Small built-in configs for tests and single-chip benchmarks.
+TINY_LLAMA = ModelConfig(
+    arch="llama", vocab_size=512, hidden_size=128, intermediate_size=256,
+    num_layers=2, num_heads=4, num_kv_heads=2, max_position_embeddings=512,
+    name="tiny-llama",
+)
+
+# facebook/opt-125m architecture (reference parity config #1, BASELINE.json).
+OPT_125M = ModelConfig(
+    arch="opt", vocab_size=50272, hidden_size=768, intermediate_size=3072,
+    num_layers=12, num_heads=12, num_kv_heads=12, max_position_embeddings=2048,
+    tie_word_embeddings=True, name="facebook/opt-125m",
+)
+
+# meta-llama/Llama-3-8B architecture (reference headline benchmark model,
+# tutorials/08-benchmark-multi-round-qa-multi-gpu.md).
+LLAMA3_8B = ModelConfig(
+    arch="llama", vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+    num_layers=32, num_heads=32, num_kv_heads=8, max_position_embeddings=8192,
+    rope_theta=500000.0, name="meta-llama/Meta-Llama-3-8B",
+)
+
+NAMED_CONFIGS = {
+    "tiny-llama": TINY_LLAMA,
+    "facebook/opt-125m": OPT_125M,
+    "meta-llama/Meta-Llama-3-8B": LLAMA3_8B,
+    "llama-3-8b": LLAMA3_8B,
+}
+
+
+def resolve_model_config(model: str) -> ModelConfig:
+    """Resolve a model name or local HF directory to a ModelConfig."""
+    if model in NAMED_CONFIGS:
+        return NAMED_CONFIGS[model]
+    if os.path.isdir(model) and os.path.exists(os.path.join(model, "config.json")):
+        return ModelConfig.from_pretrained_dir(model)
+    raise ValueError(
+        f"Unknown model {model!r}: not a named config ({list(NAMED_CONFIGS)}) "
+        "and not a local HuggingFace directory"
+    )

@@ -1,0 +1,168 @@
+"""Llama-family decoder (Llama 2/3, Mistral, Qwen2) — functional JAX.
+
+TPU-first design notes:
+  * Parameters are a plain pytree with all decoder layers STACKED on a leading
+    ``L`` axis and the forward pass runs ``lax.scan`` over layers — one traced
+    layer body instead of L inlined copies, which keeps XLA compile time flat
+    in depth and produces identical per-layer fusions.
+  * Activations are bfloat16; norms/softmax/rope math in float32.
+  * Attention reads/writes the paged KV pool (production_stack_tpu/ops/attention.py),
+    so prefill chunks and decode steps share this one forward function.
+
+Weight layout matches HuggingFace LlamaForCausalLM for direct safetensors
+loading (production_stack_tpu/engine/weights.py).
+"""
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from production_stack_tpu.models.config import ModelConfig
+from production_stack_tpu.ops.attention import paged_attention, write_kv_to_pool
+
+Params = Dict
+
+
+def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+def _rope_cos_sin(positions: jax.Array, head_dim: int, theta: float):
+    """cos/sin tables for the given absolute positions. positions: [B, T]."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B, T, Dh/2]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """HF-convention rotary embedding (rotate-half). x: [B, T, H, Dh]."""
+    xf = x.astype(jnp.float32)
+    x1, x2 = jnp.split(xf, 2, axis=-1)
+    c = cos[:, :, None, :]
+    s = sin[:, :, None, :]
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)
+
+
+def init_params(cfg: ModelConfig, rng: jax.Array, dtype=jnp.bfloat16) -> Params:
+    d, f, dh = cfg.hidden_size, cfg.intermediate_size, cfg.head_dim_
+    h, hkv, nl, v = cfg.num_heads, cfg.num_kv_heads, cfg.num_layers, cfg.vocab_size
+    keys = jax.random.split(rng, 10)
+
+    def w(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) * fan_in**-0.5).astype(dtype)
+
+    layers = {
+        "attn_norm": jnp.ones((nl, d), dtype),
+        "mlp_norm": jnp.ones((nl, d), dtype),
+        "wq": w(keys[0], (nl, d, h * dh), d),
+        "wk": w(keys[1], (nl, d, hkv * dh), d),
+        "wv": w(keys[2], (nl, d, hkv * dh), d),
+        "wo": w(keys[3], (nl, h * dh, d), h * dh),
+        "w_gate": w(keys[4], (nl, d, f), d),
+        "w_up": w(keys[5], (nl, d, f), d),
+        "w_down": w(keys[6], (nl, f, d), f),
+    }
+    if cfg.attention_bias:
+        layers["bq"] = jnp.zeros((nl, h * dh), dtype)
+        layers["bk"] = jnp.zeros((nl, hkv * dh), dtype)
+        layers["bv"] = jnp.zeros((nl, hkv * dh), dtype)
+    params = {
+        "embed": w(keys[7], (v, d), d),
+        "layers": layers,
+        "final_norm": jnp.ones((d,), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = w(keys[8], (d, v), d)
+    return params
+
+
+def _layer_body(
+    cfg: ModelConfig,
+    block_size: int,
+    attn_impl: str,
+    hidden: jax.Array,        # [B, T, D]
+    lp: Dict,                 # one layer's params (leading L axis sliced off)
+    k_pool: jax.Array,        # [num_slots, Hkv, Dh]
+    v_pool: jax.Array,
+    cos: jax.Array,
+    sin: jax.Array,
+    slot_mapping: jax.Array,
+    block_tables: jax.Array,
+    kv_lens: jax.Array,
+    q_positions: jax.Array,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    b, t, d = hidden.shape
+    h, hkv, dh = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
+
+    x = rms_norm(hidden, lp["attn_norm"], cfg.rms_norm_eps)
+    q = x @ lp["wq"]
+    k = x @ lp["wk"]
+    v = x @ lp["wv"]
+    if cfg.attention_bias:
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
+    q = q.reshape(b, t, h, dh)
+    k = k.reshape(b, t, hkv, dh)
+    v = v.reshape(b, t, hkv, dh)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    k_pool, v_pool = write_kv_to_pool(k_pool, v_pool, k, v, slot_mapping)
+    attn = paged_attention(
+        q, k_pool, v_pool, block_tables, kv_lens, q_positions,
+        block_size=block_size, impl=attn_impl,
+    )
+    hidden = hidden + attn.reshape(b, t, h * dh) @ lp["wo"]
+
+    x = rms_norm(hidden, lp["mlp_norm"], cfg.rms_norm_eps)
+    mlp = (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+    return hidden + mlp, k_pool, v_pool
+
+
+def forward(
+    params: Params,
+    cfg: ModelConfig,
+    token_ids: jax.Array,     # [B, T]
+    positions: jax.Array,     # [B, T]
+    kv_k: jax.Array,          # [L, num_slots, Hkv, Dh]
+    kv_v: jax.Array,
+    slot_mapping: jax.Array,  # [B, T]
+    block_tables: jax.Array,  # [B, Mb]
+    kv_lens: jax.Array,       # [B]
+    *,
+    block_size: int,
+    attn_impl: str = "xla",
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Returns (hidden [B,T,D], kv_k, kv_v) with current-chunk KV written."""
+    hidden = params["embed"][token_ids].astype(kv_k.dtype)
+    cos, sin = _rope_cos_sin(positions, cfg.head_dim_, cfg.rope_theta)
+
+    def scan_fn(h_carry, xs):
+        lp, kp, vp = xs
+        h_out, kp, vp = _layer_body(
+            cfg, block_size, attn_impl, h_carry, lp, kp, vp,
+            cos, sin, slot_mapping, block_tables, kv_lens, positions,
+        )
+        return h_out, (kp, vp)
+
+    hidden, (kv_k, kv_v) = jax.lax.scan(
+        scan_fn, hidden, (params["layers"], kv_k, kv_v)
+    )
+    hidden = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
+    return hidden, kv_k, kv_v
+
+
+def compute_logits(params: Params, cfg: ModelConfig, hidden: jax.Array) -> jax.Array:
+    """hidden [..., D] -> logits [..., V] in float32."""
+    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    return jnp.dot(
+        hidden, head.astype(hidden.dtype), preferred_element_type=jnp.float32
+    )

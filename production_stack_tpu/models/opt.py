@@ -1,0 +1,103 @@
+"""OPT-family decoder (facebook/opt-125m etc.) — functional JAX.
+
+Kept deliberately close in structure to models/llama.py (stacked layers +
+lax.scan, paged KV pool attention) but with OPT's architecture: LayerNorm with
+bias, learned position embeddings with OPT's +2 offset quirk, GELU MLP, tied
+LM head. opt-125m is the reference's minimal parity config
+(values-01-minimal-example, BASELINE.json).
+"""
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from production_stack_tpu.models.config import ModelConfig
+from production_stack_tpu.ops.attention import paged_attention, write_kv_to_pool
+
+Params = Dict
+_OPT_POS_OFFSET = 2  # HF OPTLearnedPositionalEmbedding offset
+
+
+def layer_norm(x: jax.Array, w: jax.Array, b: jax.Array, eps: float = 1e-5) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w + b
+
+
+def init_params(cfg: ModelConfig, rng: jax.Array, dtype=jnp.bfloat16) -> Params:
+    d, f = cfg.hidden_size, cfg.intermediate_size
+    h, dh, nl, v = cfg.num_heads, cfg.head_dim_, cfg.num_layers, cfg.vocab_size
+    keys = jax.random.split(rng, 8)
+
+    def w(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) * fan_in**-0.5).astype(dtype)
+
+    layers = {
+        "ln1_w": jnp.ones((nl, d), dtype), "ln1_b": jnp.zeros((nl, d), dtype),
+        "ln2_w": jnp.ones((nl, d), dtype), "ln2_b": jnp.zeros((nl, d), dtype),
+        "wq": w(keys[0], (nl, d, h * dh), d), "bq": jnp.zeros((nl, h * dh), dtype),
+        "wk": w(keys[1], (nl, d, h * dh), d), "bk": jnp.zeros((nl, h * dh), dtype),
+        "wv": w(keys[2], (nl, d, h * dh), d), "bv": jnp.zeros((nl, h * dh), dtype),
+        "wo": w(keys[3], (nl, h * dh, d), h * dh), "bo": jnp.zeros((nl, d), dtype),
+        "fc1": w(keys[4], (nl, d, f), d), "fc1_b": jnp.zeros((nl, f), dtype),
+        "fc2": w(keys[5], (nl, f, d), f), "fc2_b": jnp.zeros((nl, d), dtype),
+    }
+    return {
+        "embed": w(keys[6], (v, d), d),
+        "pos_embed": w(keys[7], (cfg.max_position_embeddings + _OPT_POS_OFFSET, d), d),
+        "layers": layers,
+        "final_ln_w": jnp.ones((d,), dtype),
+        "final_ln_b": jnp.zeros((d,), dtype),
+    }
+
+
+def _layer_body(cfg, block_size, attn_impl, hidden, lp,
+                k_pool, v_pool, slot_mapping, block_tables, kv_lens, q_positions):
+    b, t, d = hidden.shape
+    h, dh = cfg.num_heads, cfg.head_dim_
+
+    x = layer_norm(hidden, lp["ln1_w"], lp["ln1_b"])
+    q = (x @ lp["wq"] + lp["bq"]).reshape(b, t, h, dh)
+    k = (x @ lp["wk"] + lp["bk"]).reshape(b, t, h, dh)
+    v = (x @ lp["wv"] + lp["bv"]).reshape(b, t, h, dh)
+
+    k_pool, v_pool = write_kv_to_pool(k_pool, v_pool, k, v, slot_mapping)
+    attn = paged_attention(
+        q, k_pool, v_pool, block_tables, kv_lens, q_positions,
+        block_size=block_size, impl=attn_impl,
+    )
+    hidden = hidden + attn.reshape(b, t, h * dh) @ lp["wo"] + lp["bo"]
+
+    x = layer_norm(hidden, lp["ln2_w"], lp["ln2_b"])
+    mlp = jax.nn.gelu(x @ lp["fc1"] + lp["fc1_b"], approximate=False) @ lp["fc2"] + lp["fc2_b"]
+    return hidden + mlp, k_pool, v_pool
+
+
+def forward(params, cfg, token_ids, positions, kv_k, kv_v,
+            slot_mapping, block_tables, kv_lens, *, block_size, attn_impl="xla"):
+    hidden = (
+        params["embed"][token_ids] + params["pos_embed"][positions + _OPT_POS_OFFSET]
+    ).astype(kv_k.dtype)
+
+    def scan_fn(h_carry, xs):
+        lp, kp, vp = xs
+        h_out, kp, vp = _layer_body(
+            cfg, block_size, attn_impl, h_carry, lp, kp, vp,
+            slot_mapping, block_tables, kv_lens, positions,
+        )
+        return h_out, (kp, vp)
+
+    hidden, (kv_k, kv_v) = jax.lax.scan(
+        scan_fn, hidden, (params["layers"], kv_k, kv_v)
+    )
+    hidden = layer_norm(hidden, params["final_ln_w"], params["final_ln_b"])
+    return hidden, kv_k, kv_v
+
+
+def compute_logits(params, cfg, hidden):
+    return jnp.dot(
+        hidden, params["embed"].T.astype(hidden.dtype),
+        preferred_element_type=jnp.float32,
+    )

@@ -1,0 +1,7 @@
+from production_stack_tpu.ops.attention import (
+    paged_attention,
+    paged_attention_xla,
+    write_kv_to_pool,
+)
+
+__all__ = ["paged_attention", "paged_attention_xla", "write_kv_to_pool"]

@@ -1,0 +1,124 @@
+"""Paged attention over a block-pooled KV cache.
+
+This is the TPU-native replacement for the paged-attention CUDA kernels that
+live inside the reference's external vLLM engine images (the reference repo
+itself ships none; see SURVEY.md §2.2 "vLLM engine").
+
+Design: the KV cache is a flat pool of slots ``[num_slots, kv_heads, head_dim]``
+per layer (num_slots = num_blocks * block_size; block 0 is the reserved null
+block). A sequence's blocks are listed in its ``block_table``; slot ``j`` in
+page order holds the KV for absolute token position ``j``. Both prefill chunks
+(T > 1) and decode (T = 1) use the same entry point, so chunked prefill and
+decode batches share one compiled program shape family.
+
+Two implementations behind one dispatch:
+  * ``xla``    — pure jnp gather + einsum. Correct everywhere (CPU tests, TPU).
+  * ``pallas`` — Pallas TPU kernel that DMAs only the live KV blocks from HBM
+    into VMEM (see production_stack_tpu/ops/pallas/paged_attention.py).
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def gather_kv_pages(pool: jax.Array, block_tables: jax.Array, block_size: int) -> jax.Array:
+    """Gather per-sequence KV from the slot pool.
+
+    pool: [num_slots, Hkv, Dh]; block_tables: [B, Mb] -> [B, Mb*bs, Hkv, Dh].
+    """
+    b, mb = block_tables.shape
+    slots = block_tables[:, :, None] * block_size + jnp.arange(
+        block_size, dtype=block_tables.dtype
+    )[None, None, :]
+    return pool[slots.reshape(b, mb * block_size)]
+
+
+@functools.partial(jax.jit, static_argnames=("block_size",))
+def paged_attention_xla(
+    q: jax.Array,             # [B, T, H, Dh]
+    k_pool: jax.Array,        # [num_slots, Hkv, Dh]
+    v_pool: jax.Array,        # [num_slots, Hkv, Dh]
+    block_tables: jax.Array,  # [B, Mb] int32
+    kv_lens: jax.Array,       # [B] int32 — total KV length incl. current chunk
+    q_positions: jax.Array,   # [B, T] int32 — absolute positions of queries
+    *,
+    block_size: int,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Reference paged attention: gather pages, masked softmax attention.
+
+    Causal semantics: query at position p attends to KV slots [0, p] of its own
+    sequence; slots beyond kv_len are masked (they may alias the null block).
+    """
+    b, t, h, dh = q.shape
+    hkv = k_pool.shape[1]
+    g = h // hkv
+    if scale is None:
+        scale = dh ** -0.5
+
+    k = gather_kv_pages(k_pool, block_tables, block_size)  # [B, S, Hkv, Dh]
+    v = gather_kv_pages(v_pool, block_tables, block_size)
+    s = k.shape[1]
+
+    qg = q.reshape(b, t, hkv, g, dh).astype(jnp.float32) * scale
+    # scores: [B, Hkv, G, T, S]
+    scores = jnp.einsum("btkgd,bskd->bkgts", qg, k.astype(jnp.float32))
+
+    key_pos = jnp.arange(s, dtype=jnp.int32)[None, :]               # [1, S]
+    valid = key_pos < kv_lens[:, None]                               # [B, S]
+    causal = key_pos[:, None, :] <= q_positions[:, :, None]          # [B, T, S]
+    mask = (valid[:, None, :] & causal)[:, None, None, :, :]         # [B,1,1,T,S]
+    scores = jnp.where(mask, scores, _NEG_INF)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgts,bskd->btkgd", probs, v.astype(jnp.float32))
+    return out.reshape(b, t, h, dh).astype(q.dtype)
+
+
+def paged_attention(
+    q, k_pool, v_pool, block_tables, kv_lens, q_positions,
+    *, block_size: int, scale: Optional[float] = None, impl: str = "xla",
+) -> jax.Array:
+    if impl == "pallas":
+        try:
+            from production_stack_tpu.ops.pallas.paged_attention import (
+                paged_attention_pallas,
+            )
+        except ImportError:
+            import warnings
+            warnings.warn(
+                "Pallas paged-attention kernel unavailable; using XLA path",
+                stacklevel=2,
+            )
+        else:
+            return paged_attention_pallas(
+                q, k_pool, v_pool, block_tables, kv_lens, q_positions,
+                block_size=block_size, scale=scale,
+            )
+    return paged_attention_xla(
+        q, k_pool, v_pool, block_tables, kv_lens, q_positions,
+        block_size=block_size, scale=scale,
+    )
+
+
+def write_kv_to_pool(
+    k_pool: jax.Array,      # [num_slots, Hkv, Dh]
+    v_pool: jax.Array,
+    k_new: jax.Array,       # [B, T, Hkv, Dh]
+    v_new: jax.Array,
+    slot_mapping: jax.Array,  # [B, T] int32 — flat slot per token; 0 = discard
+) -> tuple:
+    """Scatter freshly-computed KV for the current tokens into the pools.
+
+    Padding tokens carry slot 0 (the reserved null block), so their writes land
+    harmlessly in slots that are never unmasked by attention.
+    """
+    flat = slot_mapping.reshape(-1)
+    kf = k_new.reshape(-1, *k_new.shape[2:]).astype(k_pool.dtype)
+    vf = v_new.reshape(-1, *v_new.shape[2:]).astype(v_pool.dtype)
+    return k_pool.at[flat].set(kf), v_pool.at[flat].set(vf)

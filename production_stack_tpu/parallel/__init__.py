@@ -1,0 +1,7 @@
+from production_stack_tpu.parallel.mesh import make_mesh
+from production_stack_tpu.parallel.sharding import (
+    kv_pool_sharding,
+    param_shardings,
+)
+
+__all__ = ["make_mesh", "param_shardings", "kv_pool_sharding"]

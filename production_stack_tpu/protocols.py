@@ -1,0 +1,76 @@
+"""OpenAI-compatible wire protocol objects.
+
+The reference uses pydantic models (reference src/vllm_router/protocols.py:37-55);
+this environment has no pydantic, so these are plain dataclasses with explicit
+`to_dict` serialization -- the JSON shapes on the wire are identical.
+"""
+
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+def random_uuid(prefix: str = "") -> str:
+    return f"{prefix}{uuid.uuid4().hex}"
+
+
+@dataclass
+class ModelCard:
+    id: str
+    object: str = "model"
+    created: int = field(default_factory=lambda: int(time.time()))
+    owned_by: str = "production-stack-tpu"
+    root: Optional[str] = None
+    parent: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "id": self.id,
+            "object": self.object,
+            "created": self.created,
+            "owned_by": self.owned_by,
+            "root": self.root,
+            "parent": self.parent,
+        }
+
+
+@dataclass
+class ModelList:
+    data: List[ModelCard] = field(default_factory=list)
+    object: str = "list"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"object": self.object, "data": [m.to_dict() for m in self.data]}
+
+
+@dataclass
+class ErrorResponse:
+    message: str
+    type: str = "invalid_request_error"
+    code: int = 400
+    param: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "error": {
+                "message": self.message,
+                "type": self.type,
+                "code": self.code,
+                "param": self.param,
+            }
+        }
+
+
+@dataclass
+class CompletionUsage:
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    total_tokens: int = 0
+
+    def to_dict(self) -> Dict[str, int]:
+        return {
+            "prompt_tokens": self.prompt_tokens,
+            "completion_tokens": self.completion_tokens,
+            "total_tokens": self.total_tokens,
+        }

@@ -1,0 +1,27 @@
+from production_stack_tpu.utils.logging import init_logger
+from production_stack_tpu.utils.misc import (
+    SingletonMeta,
+    SingletonABCMeta,
+    cdiv,
+    round_up,
+    parse_comma_separated,
+    parse_static_model_names,
+    parse_static_urls,
+    set_ulimit,
+    validate_url,
+)
+from production_stack_tpu.utils.hashring import HashRing
+
+__all__ = [
+    "init_logger",
+    "SingletonMeta",
+    "SingletonABCMeta",
+    "cdiv",
+    "round_up",
+    "parse_comma_separated",
+    "parse_static_model_names",
+    "parse_static_urls",
+    "set_ulimit",
+    "validate_url",
+    "HashRing",
+]

@@ -1,0 +1,69 @@
+"""Consistent hash ring.
+
+The reference's SessionRouter uses the external `uhashring` package
+(reference src/vllm_router/routers/routing_logic.py:96-189). This is an
+in-repo implementation with the same observable behavior: stable key->node
+mapping that only reassigns ~1/N of keys when a node joins or leaves.
+"""
+
+import bisect
+import hashlib
+from typing import Dict, Iterable, List, Optional
+
+
+def _hash(key: str) -> int:
+    return int.from_bytes(hashlib.md5(key.encode()).digest()[:8], "big")
+
+
+class HashRing:
+    def __init__(self, nodes: Optional[Iterable[str]] = None, vnodes: int = 160):
+        self._vnodes = vnodes
+        self._ring: Dict[int, str] = {}
+        self._sorted_keys: List[int] = []
+        self._nodes: set = set()
+        for n in nodes or []:
+            self.add_node(n)
+
+    @property
+    def nodes(self) -> List[str]:
+        return sorted(self._nodes)
+
+    def add_node(self, node: str) -> None:
+        if node in self._nodes:
+            return
+        self._nodes.add(node)
+        for i in range(self._vnodes):
+            h = _hash(f"{node}#{i}")
+            self._ring[h] = node
+            bisect.insort(self._sorted_keys, h)
+
+    def remove_node(self, node: str) -> None:
+        if node not in self._nodes:
+            return
+        self._nodes.discard(node)
+        for i in range(self._vnodes):
+            h = _hash(f"{node}#{i}")
+            if self._ring.get(h) == node:
+                del self._ring[h]
+                idx = bisect.bisect_left(self._sorted_keys, h)
+                if idx < len(self._sorted_keys) and self._sorted_keys[idx] == h:
+                    self._sorted_keys.pop(idx)
+
+    def set_nodes(self, nodes: Iterable[str]) -> None:
+        target = set(nodes)
+        for n in list(self._nodes - target):
+            self.remove_node(n)
+        for n in target - self._nodes:
+            self.add_node(n)
+
+    def get_node(self, key: str) -> Optional[str]:
+        if not self._sorted_keys:
+            return None
+        h = _hash(key)
+        idx = bisect.bisect(self._sorted_keys, h)
+        if idx == len(self._sorted_keys):
+            idx = 0
+        return self._ring[self._sorted_keys[idx]]
+
+    def __len__(self) -> int:
+        return len(self._nodes)

@@ -1,0 +1,32 @@
+"""Uniform logging for the stack (contract of reference src/vllm_router/log.py)."""
+
+import logging
+import os
+import sys
+
+_FORMAT = "[%(asctime)s] %(levelname)s %(name)s: %(message)s"
+_DATEFMT = "%Y-%m-%d %H:%M:%S"
+
+_configured = False
+
+
+def _configure_root() -> None:
+    global _configured
+    if _configured:
+        return
+    level = os.environ.get("PSTPU_LOG_LEVEL", "INFO").upper()
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(_FORMAT, datefmt=_DATEFMT))
+    root = logging.getLogger("production_stack_tpu")
+    root.setLevel(level)
+    if not root.handlers:
+        root.addHandler(handler)
+    root.propagate = False
+    _configured = True
+
+
+def init_logger(name: str) -> logging.Logger:
+    _configure_root()
+    if not name.startswith("production_stack_tpu"):
+        name = f"production_stack_tpu.{name}"
+    return logging.getLogger(name)

@@ -1,0 +1,77 @@
+"""Small shared helpers.
+
+Parity with reference src/vllm_router/utils.py (SingletonMeta :10-39, URL
+validation :42-60, set_ulimit :64-79, static URL/model parsing :82-95) --
+re-designed, not translated.
+"""
+
+import abc
+import re
+import resource
+from typing import Any, Dict, List
+
+from production_stack_tpu.utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+_URL_RE = re.compile(r"^https?://[-A-Za-z0-9.:_\[\]]+(?:/[-A-Za-z0-9._~%/]*)?$")
+
+
+class SingletonMeta(type):
+    """Metaclass giving each class a process-wide single instance.
+
+    The instance registry is intentionally exposed (`_instances`) so tests can
+    reset global state between cases -- the reference relies on the same seam
+    (src/tests/test_singleton.py:13-29).
+    """
+
+    _instances: Dict[type, Any] = {}
+
+    def __call__(cls, *args, **kwargs):
+        if cls not in cls._instances:
+            cls._instances[cls] = super().__call__(*args, **kwargs)
+        return cls._instances[cls]
+
+
+class SingletonABCMeta(abc.ABCMeta, SingletonMeta):
+    pass
+
+
+def cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def round_up(x: int, multiple: int) -> int:
+    return cdiv(x, multiple) * multiple
+
+
+def validate_url(url: str) -> bool:
+    return bool(_URL_RE.match(url))
+
+
+def parse_comma_separated(value: str) -> List[str]:
+    return [v for v in (s.strip() for s in value.split(",")) if v]
+
+
+def parse_static_urls(static_backends: str) -> List[str]:
+    urls = parse_comma_separated(static_backends)
+    for url in urls:
+        if not validate_url(url):
+            raise ValueError(f"Invalid backend URL: {url!r}")
+    return urls
+
+
+def parse_static_model_names(static_models: str) -> List[str]:
+    return parse_comma_separated(static_models)
+
+
+def set_ulimit(target_soft: int = 65535) -> None:
+    """Raise RLIMIT_NOFILE so the router can hold many concurrent streams."""
+    try:
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        if soft < target_soft:
+            resource.setrlimit(
+                resource.RLIMIT_NOFILE, (min(target_soft, hard), hard)
+            )
+    except (ValueError, OSError) as e:
+        logger.warning("Could not raise RLIMIT_NOFILE: %s", e)

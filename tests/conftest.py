@@ -1,0 +1,52 @@
+"""Test harness config: run everything on a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; sharding correctness is
+validated on XLA's CPU backend with 8 virtual devices (the driver separately
+dry-runs the multi-chip path via __graft_entry__.dryrun_multichip).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# Force CPU: the ambient environment may pin jax to the real TPU (the "axon"
+# platform is registered by a sitecustomize hook that overrides JAX_PLATFORMS,
+# so the config knob must be set post-import, pre-backend-init). Unit tests
+# must be deterministic and multi-device.
+if os.environ.get("PSTPU_TEST_REAL_DEVICE", "") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import asyncio
+import functools
+import inspect
+
+import pytest
+
+
+def pytest_collection_modifyitems(items):
+    """Run ``async def`` tests via asyncio.run (no pytest-asyncio available)."""
+    for item in items:
+        if inspect.iscoroutinefunction(getattr(item, "function", None)):
+            item.obj = _sync_wrapper(item.function)
+
+
+def _sync_wrapper(fn):
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        return asyncio.run(fn(*args, **kwargs))
+    return wrapper
+
+
+@pytest.fixture(autouse=True)
+def _reset_singletons():
+    from production_stack_tpu.utils import SingletonMeta
+    SingletonMeta._instances.clear()
+    yield
+    SingletonMeta._instances.clear()

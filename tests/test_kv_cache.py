@@ -1,0 +1,69 @@
+"""Block pool manager: allocation, prefix caching, eviction LRU."""
+
+from production_stack_tpu.engine.kv_cache import BlockPoolManager
+
+
+def test_basic_alloc_free():
+    bm = BlockPoolManager(num_blocks=9, block_size=4)
+    assert bm.num_free_blocks == 8
+    blocks = bm.allocate_blocks(3)
+    assert len(blocks) == 3 and 0 not in blocks
+    assert bm.num_used_blocks == 3
+    bm.free_blocks(blocks)
+    assert bm.num_free_blocks == 8
+    assert 0.0 <= bm.usage() <= 1.0
+
+
+def test_prefix_cache_hit_roundtrip():
+    bm = BlockPoolManager(num_blocks=32, block_size=4)
+    prompt = list(range(10))  # 2 full blocks + 2 tokens
+    blocks, n_cached = bm.allocate_prompt(prompt)
+    assert n_cached == 0 and len(blocks) == 3
+
+    # Simulate prefill completing: register the two full blocks.
+    h1 = bm.register_full_block(blocks[0], b"", prompt[0:4])
+    bm.register_full_block(blocks[1], h1, prompt[4:8])
+    bm.free_blocks(blocks)  # request finished; blocks become evictable-cached
+
+    # Same prompt again: both full blocks should hit.
+    blocks2, n_cached2 = bm.allocate_prompt(prompt)
+    assert n_cached2 == 8
+    assert blocks2[:2] == blocks[:2]
+    assert bm.prefix_hits_total == 8
+    assert bm.prefix_queries_total == 20
+
+
+def test_prefix_never_caches_whole_prompt():
+    bm = BlockPoolManager(num_blocks=32, block_size=4)
+    prompt = list(range(8))  # exactly 2 full blocks
+    blocks, _ = bm.allocate_prompt(prompt)
+    h1 = bm.register_full_block(blocks[0], b"", prompt[0:4])
+    bm.register_full_block(blocks[1], h1, prompt[4:8])
+    bm.free_blocks(blocks)
+    # Only the first block may be reused: >= 1 token must be recomputed.
+    blocks2, n_cached = bm.allocate_prompt(prompt)
+    assert n_cached == 4
+    assert blocks2[0] == blocks[0] and blocks2[1] != blocks[1]
+
+
+def test_eviction_lru_reclaims_cached_blocks():
+    bm = BlockPoolManager(num_blocks=5, block_size=4)  # 4 usable
+    a = bm.allocate_blocks(4)
+    for i, blk in enumerate(a):
+        bm.register_full_block(blk, b"", [100 + i] * 4)
+    bm.free_blocks(a)
+    assert bm.num_free_blocks == 4
+    # All free blocks are cached; new allocation must evict LRU (a[0] first).
+    b = bm.allocate_blocks(2)
+    assert set(b) == {a[0], a[1]}
+    # a[2], a[3] still cached and reusable via hash.
+    hits, _ = bm.lookup_prefix([102] * 4 + [0])
+    assert hits == [a[2]]
+
+
+def test_out_of_blocks_returns_none():
+    bm = BlockPoolManager(num_blocks=3, block_size=4)
+    assert bm.allocate_blocks(3) is None
+    got = bm.allocate_blocks(2)
+    assert got is not None
+    assert bm.allocate_prompt(list(range(5))) is None
