@@ -94,6 +94,22 @@ TINY_LLAMA = ModelConfig(
     name="tiny-llama",
 )
 
+# Variant with 8 KV heads so tensor parallelism up to tp=8 shards the KV
+# pool for real in multi-chip dry runs (tiny-llama's 2 KV heads cap tp at 2).
+TINY_LLAMA_8KV = ModelConfig(
+    arch="llama", vocab_size=512, hidden_size=256, intermediate_size=512,
+    num_layers=2, num_heads=8, num_kv_heads=8, max_position_embeddings=512,
+    name="tiny-llama-8kv",
+)
+
+# TinyLlama-1.1B shape: fits a single v5e chip with room for KV; used by
+# bench.py for single-chip throughput (the 8B headline model needs the mesh).
+LLAMA_1B = ModelConfig(
+    arch="llama", vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+    num_layers=22, num_heads=32, num_kv_heads=4, max_position_embeddings=2048,
+    name="llama-1b",
+)
+
 # facebook/opt-125m architecture (reference parity config #1, BASELINE.json).
 OPT_125M = ModelConfig(
     arch="opt", vocab_size=50272, hidden_size=768, intermediate_size=3072,
@@ -111,6 +127,8 @@ LLAMA3_8B = ModelConfig(
 
 NAMED_CONFIGS = {
     "tiny-llama": TINY_LLAMA,
+    "tiny-llama-8kv": TINY_LLAMA_8KV,
+    "llama-1b": LLAMA_1B,
     "facebook/opt-125m": OPT_125M,
     "meta-llama/Meta-Llama-3-8B": LLAMA3_8B,
     "llama-3-8b": LLAMA3_8B,
