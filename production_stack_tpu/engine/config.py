@@ -25,6 +25,11 @@ class EngineConfig:
     # --- scheduler ---
     max_num_seqs: int = 64
     max_num_batched_tokens: int = 1024      # prefill chunk token budget
+    # Decode steps fused into ONE device dispatch (lax.scan inside the jit):
+    # K*B tokens per host round-trip instead of B. Host-side stop conditions
+    # (EOS, stop strings, aborts) are applied after the fetch, so up to K-1
+    # tokens per sequence are speculatively computed and discarded.
+    num_decode_steps: int = 8
     # --- parallelism (jax.sharding over the TPU slice mesh) ---
     tensor_parallel_size: int = 1
     sequence_parallel_size: int = 1         # ring-attention axis for long prefill
@@ -47,6 +52,15 @@ class EngineConfig:
     # --- weights ---
     load_format: str = "auto"               # "auto" | "safetensors" | "dummy"
     seed: int = 0
+    # --- compilation ---
+    # Persistent XLA compile cache: step-shape compiles (tens of seconds on
+    # TPU) are paid once per machine, not once per process. Empty disables.
+    compilation_cache_dir: str = field(
+        default_factory=lambda: os.environ.get(
+            "PSTPU_COMPILATION_CACHE",
+            os.path.expanduser("~/.cache/pstpu_xla"),
+        )
+    )
     # --- serving ---
     served_model_name: Optional[str] = None
 

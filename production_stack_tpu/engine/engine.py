@@ -201,8 +201,10 @@ class ServingEngine:
                         self._process_output(aborted)
                 continue
             self.last_step_time = time.monotonic()
-            produced = self.scheduler.update_after_step(batch, next_tokens)
-            self.generation_tokens_total += len(produced)
+            produced, accepted = self.scheduler.update_after_step(
+                batch, next_tokens
+            )
+            self.generation_tokens_total += accepted
             for seq in produced:
                 self._process_output(seq)
             await asyncio.sleep(0)
@@ -240,6 +242,17 @@ class ServingEngine:
                     idx = i
             if idx != -1:
                 st.text = st.text[:idx]
+                # Drop sampled-past-the-stop tokens (the fused K-step decode
+                # can overshoot a stop match by up to K-1 tokens) so token_ids
+                # and usage reflect the delivered text, not the speculation.
+                toks = seq.output_token_ids
+                m = 0
+                while m < len(toks) and len(
+                    self.tokenizer.decode(toks[:m])
+                ) < idx:
+                    m += 1
+                self.generation_tokens_total -= len(toks) - m
+                seq.output_token_ids = toks[:m]
                 self.scheduler.finish(
                     seq.request_id, SequenceStatus.FINISHED_STOPPED
                 )

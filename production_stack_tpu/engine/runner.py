@@ -42,6 +42,39 @@ def _dtype(name: str):
             "float16": jnp.float16}[name]
 
 
+def _token_seed(seq: Sequence, gen_index: int) -> np.uint32:
+    """Seed for the token at generation index `gen_index` of `seq`.
+
+    Per-sequence-deterministic: the same request produces the same tokens
+    regardless of batching, scan length, or prefill/decode path — both
+    dispatch paths MUST derive seeds through this one helper.
+    """
+    sp = seq.sampling
+    base = sp.seed if sp.seed is not None else (hash(seq.request_id) & 0x7FFFFFFF)
+    return np.uint32((base * 1000003 + gen_index) & 0xFFFFFFFF)
+
+
+_cache_configured = False
+
+
+def _setup_compilation_cache(cache_dir: str) -> None:
+    """Point XLA's persistent compile cache at `cache_dir` (process-global;
+    first engine wins, later engines with a different dir are ignored)."""
+    global _cache_configured
+    if _cache_configured:
+        return
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        _cache_configured = True
+    except Exception:  # noqa: BLE001 — older jax without the knob
+        logger.warning("Persistent compilation cache unavailable")
+        return
+    try:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:  # noqa: BLE001 — knob added later than cache_dir
+        pass
+
+
 class ModelRunner:
     def __init__(
         self,
@@ -56,6 +89,8 @@ class ModelRunner:
         self.mesh = mesh
         self.attn_impl = config.resolved_attn_impl()
         self.dtype = _dtype(config.dtype)
+        if config.compilation_cache_dir:
+            _setup_compilation_cache(config.compilation_cache_dir)
 
         init_fn, self._forward, self._logits_fn = get_model_fns(model_config)
         if params is None:
@@ -77,6 +112,11 @@ class ModelRunner:
         self.kv_v = jax.device_put(jnp.zeros(kv_shape, self.dtype), kv_sh)
 
         self._step = jax.jit(self._step_impl, donate_argnums=(1, 2))
+        self._decode_multi = jax.jit(
+            self._decode_multi_impl,
+            static_argnames=("num_steps",),
+            donate_argnums=(1, 2),
+        )
 
     # ------------------------------------------------------------------ sizing
     def _derive_num_blocks(self) -> int:
@@ -117,29 +157,110 @@ class ModelRunner:
         next_tokens = sample_tokens(logits, temps, top_k, top_p, seeds)
         return next_tokens, kv_k, kv_v
 
-    # ---------------------------------------------------------- batch assembly
-    def execute(self, batch: ScheduledBatch, step_counter: int) -> List[int]:
+    def _decode_multi_impl(self, params, kv_k, kv_v, tokens0, pos0,
+                           block_tables, slot_steps, kv_len0, temps, top_k,
+                           top_p, seed_steps, *, num_steps: int):
+        """K fused decode steps: lax.scan feeds each step's sampled token into
+        the next forward, so only ONE [K, B] host fetch happens per dispatch
+        (the per-step device->host sync is the serving bottleneck, not FLOPs).
+
+        Rows whose per-seq budget < num_steps have their excess KV writes
+        routed to the null block by slot_steps; their excess sampled tokens
+        are discarded host-side.
+        """
+        max_len = self.config.max_model_len
+
+        def body(carry, xs):
+            kv_k, kv_v, toks = carry
+            slot_j, seeds_j, j = xs
+            positions = jnp.minimum(pos0 + j, max_len - 1)[:, None]
+            kv_lens = jnp.minimum(kv_len0 + j, max_len)
+            hidden, kv_k, kv_v = self._forward(
+                params, self.model_config, toks[:, None], positions,
+                kv_k, kv_v, slot_j[:, None], block_tables, kv_lens,
+                block_size=self.config.block_size, attn_impl=self.attn_impl,
+            )
+            logits = self._logits_fn(params, self.model_config, hidden[:, 0])
+            nxt = sample_tokens(logits, temps, top_k, top_p, seeds_j)
+            return (kv_k, kv_v, nxt), nxt
+
+        (kv_k, kv_v, _), toks_all = jax.lax.scan(
+            body, (kv_k, kv_v, tokens0),
+            (slot_steps, seed_steps, jnp.arange(num_steps, dtype=jnp.int32)),
+        )
+        return toks_all, kv_k, kv_v  # toks_all: [K, B]
+
+    def _execute_decode(self, batch: ScheduledBatch) -> List[List[int]]:
         cfg = self.config
         bs = cfg.block_size
-        if batch.kind == "prefill":
-            seq = batch.seqs[0]
-            start, n = batch.chunk_starts[0], batch.chunk_lens[0]
-            t = _bucket(n, 8, max(8, cfg.max_num_batched_tokens))
-            b = 1
-            tokens_list = [seq.all_token_ids[start:start + n]]
-            pos_list = [list(range(start, start + n))]
-            seqs = [seq]
-        else:
-            seqs = batch.seqs
-            b = _bucket(len(seqs), 1, max(1, cfg.max_num_seqs))
-            t = 1
-            tokens_list = [[s.all_token_ids[s.num_computed_tokens]] for s in seqs]
-            pos_list = [[s.num_computed_tokens] for s in seqs]
+        seqs = batch.seqs
+        k = batch.num_steps
+        b = _bucket(len(seqs), 1, max(1, cfg.max_num_seqs))
+        mb = _bucket(max(len(s.block_ids) for s in seqs), 1,
+                     max(1, cfg.max_blocks_per_seq))
 
-        max_blocks_needed = max(
-            len(s.block_ids) for s in seqs
+        tokens0 = np.zeros((b,), np.int32)
+        pos0 = np.zeros((b,), np.int32)
+        kv_len0 = np.ones((b,), np.int32)
+        block_tables = np.zeros((b, mb), np.int32)
+        slot_steps = np.zeros((k, b), np.int32)    # 0 -> null block
+        seed_steps = np.zeros((k, b), np.uint32)
+        temps = np.zeros((b,), np.float32)
+        top_k = np.full((b,), -1, np.int32)
+        top_p = np.ones((b,), np.float32)
+
+        for i, s in enumerate(seqs):
+            pos = s.num_computed_tokens
+            tokens0[i] = s.all_token_ids[pos]
+            pos0[i] = pos
+            kv_len0[i] = pos + 1
+            block_tables[i, :len(s.block_ids)] = s.block_ids
+            for j in range(batch.decode_steps[i]):
+                p = pos + j
+                slot_steps[j, i] = s.block_ids[p // bs] * bs + p % bs
+            sp = s.sampling
+            temps[i] = sp.temperature
+            top_k[i] = sp.top_k
+            top_p[i] = sp.top_p
+            n_out = len(s.output_token_ids)
+            for j in range(k):
+                seed_steps[j, i] = _token_seed(s, n_out + j)
+
+        toks_all, self.kv_k, self.kv_v = self._decode_multi(
+            self.params, self.kv_k, self.kv_v,
+            jnp.asarray(tokens0), jnp.asarray(pos0),
+            jnp.asarray(block_tables), jnp.asarray(slot_steps),
+            jnp.asarray(kv_len0), jnp.asarray(temps), jnp.asarray(top_k),
+            jnp.asarray(top_p), jnp.asarray(seed_steps), num_steps=k,
         )
-        mb = _bucket(max_blocks_needed, 1, max(1, cfg.max_blocks_per_seq))
+        out = np.asarray(toks_all)  # ONE [K, B] fetch per K*B tokens
+        return [
+            [int(out[j, i]) for j in range(batch.decode_steps[i])]
+            for i in range(len(seqs))
+        ]
+
+    # ---------------------------------------------------------- batch assembly
+    def execute(self, batch: ScheduledBatch, step_counter: int) -> List[List[int]]:
+        """Run one dispatch; returns per-sequence NEW token lists (empty for
+        a non-final prefill chunk, whose sampled token is never fetched)."""
+        if batch.kind == "decode":
+            return self._execute_decode(batch)
+        cfg = self.config
+        bs = cfg.block_size
+        seq = batch.seqs[0]
+        start, n = batch.chunk_starts[0], batch.chunk_lens[0]
+        t = _bucket(n, 8, max(8, cfg.max_num_batched_tokens))
+        b = 1
+        tokens_list = [seq.all_token_ids[start:start + n]]
+        pos_list = [list(range(start, start + n))]
+        seqs = [seq]
+        final_chunk = start + n >= seq.num_tokens
+
+        # Prefill always uses the FULL block-table bucket: prefill is
+        # compute-bound, so the extra gather width costs little, and it keeps
+        # the prefill compile-cache keyed on t alone (decode, which is
+        # gather-bound, keeps per-size mb buckets).
+        mb = _bucket(cfg.max_blocks_per_seq, 1, max(1, cfg.max_blocks_per_seq))
 
         token_ids = np.zeros((b, t), np.int32)
         positions = np.zeros((b, t), np.int32)
@@ -166,14 +287,7 @@ class ModelRunner:
             temps[i] = sp.temperature
             top_k[i] = sp.top_k
             top_p[i] = sp.top_p
-            # Seed derivation must be per-sequence-deterministic (same seed ->
-            # same tokens regardless of how requests were batched together),
-            # so mix the per-request generation index, NOT the global step.
-            base = sp.seed if sp.seed is not None else \
-                (hash(s.request_id) & 0x7FFFFFFF)
-            seeds[i] = np.uint32(
-                (base * 1000003 + len(s.output_token_ids)) & 0xFFFFFFFF
-            )
+            seeds[i] = _token_seed(s, len(s.output_token_ids))
 
         next_tokens, self.kv_k, self.kv_v = self._step(
             self.params, self.kv_k, self.kv_v,
@@ -183,8 +297,11 @@ class ModelRunner:
             jnp.asarray(temps), jnp.asarray(top_k), jnp.asarray(top_p),
             jnp.asarray(seeds),
         )
-        out = np.asarray(next_tokens)[:len(seqs)]
-        return [int(x) for x in out]
+        if not final_chunk:
+            # Mid-prompt chunk: the sampled token is meaningless — skip the
+            # blocking device->host fetch entirely.
+            return [[]]
+        return [[int(np.asarray(next_tokens)[0])]]
 
     # ------------------------------------------------------------- maintenance
     def warmup(self) -> None:

@@ -91,10 +91,17 @@ class ScheduledBatch:
     seqs: List[Sequence]
     chunk_starts: List[int] = field(default_factory=list)  # prefill only
     chunk_lens: List[int] = field(default_factory=list)
+    # decode only: scan length of the fused dispatch, and per-sequence budget
+    # (a sequence with fewer allocated/needed steps than num_steps has its
+    # excess writes masked to the null block and its excess tokens discarded).
+    num_steps: int = 1
+    decode_steps: List[int] = field(default_factory=list)
 
     @property
     def num_tokens(self) -> int:
-        return sum(self.chunk_lens) if self.kind == "prefill" else len(self.seqs)
+        if self.kind == "prefill":
+            return sum(self.chunk_lens)
+        return sum(self.decode_steps) or len(self.seqs)
 
 
 class Scheduler:
@@ -191,31 +198,57 @@ class Scheduler:
     def _schedule_decode(self) -> Optional[ScheduledBatch]:
         if not self.running:
             return None
+        bs = self.config.block_size
+        max_k = max(1, self.config.num_decode_steps)
         scheduled: List[Sequence] = []
+        steps: List[int] = []
         for seq in list(self.running):
             if seq not in self.running:
                 # Preempted by an earlier iteration of this same pass.
                 continue
-            # Position being written this step:
+            # Positions written this dispatch: pos .. pos+want-1. `want` is
+            # capped by model-length capacity and the request's remaining
+            # token budget so the fused scan rarely computes discarded steps.
             pos = seq.num_computed_tokens
-            need_blocks = pos // self.config.block_size + 1
+            want = max(1, min(
+                max_k,
+                self.config.max_model_len - pos,
+                seq.sampling.max_tokens - len(seq.output_token_ids),
+            ))
+            need_blocks = (pos + want - 1) // bs + 1
             while len(seq.block_ids) < need_blocks:
                 blk = self.block_manager.append_block()
-                if blk is None:
-                    victim = self._pick_preemption_victim(exclude=scheduled)
-                    if victim is None or victim is seq:
-                        # Cannot make space without killing `seq` itself;
-                        # preempt seq and stop scheduling it this step.
-                        self._preempt(seq)
-                        break
-                    self._preempt(victim)
+                if blk is not None:
+                    seq.block_ids.append(blk)
                     continue
-                seq.block_ids.append(blk)
-            else:
-                scheduled.append(seq)
+                if len(seq.block_ids) * bs > pos:
+                    break  # partial allocation still allows >= 1 step
+                victim = self._pick_preemption_victim(exclude=scheduled)
+                if victim is None or victim is seq:
+                    # Cannot make space without killing `seq` itself;
+                    # preempt seq and stop scheduling it this step.
+                    self._preempt(seq)
+                    break
+                self._preempt(victim)
+            if seq not in self.running:
+                continue
+            avail = len(seq.block_ids) * bs - pos
+            if avail <= 0:
+                continue
+            scheduled.append(seq)
+            steps.append(min(want, avail))
         if not scheduled:
             return None
-        return ScheduledBatch(kind="decode", seqs=scheduled)
+        # Scan length is the power-of-two bucket of the largest per-seq budget
+        # (bounds the compile-cache like the batch/token buckets do).
+        num_steps = 1
+        while num_steps < max(steps):
+            num_steps *= 2
+        num_steps = min(num_steps, max_k)
+        return ScheduledBatch(
+            kind="decode", seqs=scheduled, num_steps=num_steps,
+            decode_steps=[min(s, num_steps) for s in steps],
+        )
 
     def _pick_preemption_victim(self, exclude: Seq[Sequence]) -> Optional[Sequence]:
         for seq in reversed(self.running):
@@ -239,19 +272,23 @@ class Scheduler:
 
     # ------------------------------------------------------- post-step update
     def update_after_step(
-        self, batch: ScheduledBatch, next_tokens: List[int]
-    ) -> List[Sequence]:
-        """Apply model outputs; returns sequences that produced a NEW token."""
+        self, batch: ScheduledBatch, token_lists: List[List[int]]
+    ) -> tuple:
+        """Apply model outputs (a token list per sequence; empty for non-final
+        prefill chunks). Returns (sequences that produced NEW tokens,
+        number of tokens accepted)."""
         produced: List[Sequence] = []
+        accepted = 0
         if batch.kind == "prefill":
             seq = batch.seqs[0]
             if seq.status.is_finished:
-                return produced  # aborted while the step was in flight
+                return produced, 0  # aborted while the step was in flight
             seq.num_computed_tokens += batch.chunk_lens[0]
             self._register_full_blocks(seq)
             if seq.num_computed_tokens >= seq.num_tokens:
                 # Prefill complete: the sampled token is the next real token.
-                self._append_token(seq, next_tokens[0])
+                self._append_token(seq, token_lists[0][0])
+                accepted += 1
                 produced.append(seq)
                 self.running.append(seq)
             else:
@@ -259,17 +296,24 @@ class Scheduler:
                 seq.status = SequenceStatus.WAITING
                 self.waiting.appendleft(seq)
         else:
-            for seq, tok in zip(batch.seqs, next_tokens):
+            for seq, toks in zip(batch.seqs, token_lists):
                 if seq.status.is_finished:
-                    continue  # aborted while the step was in flight
-                seq.num_computed_tokens += 1
-                self._register_full_blocks(seq)
-                self._append_token(seq, tok)
-                produced.append(seq)
+                    continue  # aborted while the dispatch was in flight
+                took = False
+                for tok in toks:
+                    if seq.status.is_finished:
+                        break  # EOS/max_tokens hit mid-scan; rest discarded
+                    seq.num_computed_tokens += 1
+                    self._register_full_blocks(seq)
+                    self._append_token(seq, tok)
+                    accepted += 1
+                    took = True
+                if took:
+                    produced.append(seq)
         for seq in produced:
             if seq.status.is_finished and seq in self.running:
                 self.running.remove(seq)
-        return produced
+        return produced, accepted
 
     def _append_token(self, seq: Sequence, token: int) -> None:
         if seq.first_token_time is None:

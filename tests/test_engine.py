@@ -114,6 +114,31 @@ def test_stop_string(engine_loop):
         assert stop_tok not in t_stopped
 
 
+def test_multistep_matches_singlestep():
+    """Fused K-step decode must produce exactly the tokens single-step does."""
+    results = {}
+    for k in (1, 8):
+        loop = asyncio.new_event_loop()
+        cfg = EngineConfig(
+            model="tiny-llama", max_model_len=128, block_size=4,
+            num_kv_blocks=64, max_num_seqs=4, max_num_batched_tokens=32,
+            attn_impl="xla", num_decode_steps=k,
+        )
+        engine = ServingEngine(cfg)
+        loop.run_until_complete(engine.start())
+        try:
+            sp = SamplingParams(temperature=0.8, top_p=0.9, max_tokens=11,
+                                seed=7, ignore_eos=True)
+            _, outs = loop.run_until_complete(
+                _collect(engine, "multistep equivalence", sp)
+            )
+            results[k] = outs[-1].token_ids
+        finally:
+            loop.run_until_complete(engine.stop())
+            loop.close()
+    assert results[1] == results[8]
+
+
 def test_preemption_under_kv_pressure():
     loop = asyncio.new_event_loop()
     cfg = EngineConfig(
