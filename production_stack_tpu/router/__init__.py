@@ -1,0 +1,8 @@
+"""OpenAI-compatible L7 request router for TPU serving-engine pods.
+
+In-repo reimplementation of the reference data plane
+(reference src/vllm_router/ — see SURVEY.md §2.1): service discovery,
+pluggable routing logic, engine/request stats, streaming proxy, dynamic
+config, feature gates, files/batch APIs. Built on aiohttp (this image has no
+FastAPI/uvicorn); the HTTP surface and metric names are contract-identical.
+"""

@@ -1,0 +1,388 @@
+"""Router app: HTTP surface + singleton wiring + entry point.
+
+The HTTP surface matches reference src/vllm_router/app.py +
+routers/main_router.py:
+  * POST /v1/chat/completions, /v1/completions, /v1/embeddings,
+    /v1/rerank — proxied via routing logic (main_router.py:42-86)
+  * GET /v1/models — union of backend models (main_router.py:95-124)
+  * GET /health — aggregates discovery + scraper thread liveness and shows
+    the live dynamic config (main_router.py:127-162)
+  * GET /metrics — router-derived Prometheus series (metrics_router.py:38-78)
+  * /v1/files, /v1/batches — files/batch services (files_router.py,
+    batches_router.py)
+
+``initialize_all`` mirrors app.py:98-211's wiring order.
+"""
+
+import asyncio
+import time
+
+import aiohttp
+from aiohttp import web
+
+from production_stack_tpu.protocols import ErrorResponse, ModelCard, ModelList
+from production_stack_tpu.router import metrics
+from production_stack_tpu.router.batch_service import LocalBatchProcessor
+from production_stack_tpu.router.callbacks import initialize_custom_callbacks
+from production_stack_tpu.router.dynamic_config import (
+    get_dynamic_config_watcher,
+    initialize_dynamic_config_watcher,
+)
+from production_stack_tpu.router.feature_gates import (
+    PII_DETECTION,
+    SEMANTIC_CACHE,
+    get_feature_gates,
+    initialize_feature_gates,
+)
+from production_stack_tpu.router.files_service import initialize_storage
+from production_stack_tpu.router.request_service import (
+    _error,
+    proxy_request,
+    route_general_request,
+)
+from production_stack_tpu.router.rewriter import get_request_rewriter
+from production_stack_tpu.router.routing_logic import (
+    get_routing_logic,
+    initialize_routing_logic,
+)
+from production_stack_tpu.router.service_discovery import (
+    get_service_discovery,
+    initialize_service_discovery,
+)
+from production_stack_tpu.router.stats import (
+    get_engine_stats_scraper,
+    get_request_stats_monitor,
+    initialize_engine_stats_scraper,
+    initialize_request_stats_monitor,
+)
+from production_stack_tpu.utils import (
+    init_logger,
+    parse_static_model_names,
+    parse_static_urls,
+    set_ulimit,
+)
+
+logger = init_logger(__name__)
+
+
+# --------------------------------------------------------------- API handlers
+async def handle_chat_completions(request: web.Request) -> web.StreamResponse:
+    cache = request.app.get("semantic_cache")
+    if cache is not None:
+        hit = await cache.check(request)
+        if hit is not None:
+            return hit
+    pii = request.app.get("pii_checker")
+    if pii is not None:
+        blocked = await pii.check(request)
+        if blocked is not None:
+            return blocked
+    return await route_general_request(request, "/v1/chat/completions")
+
+
+async def handle_completions(request: web.Request) -> web.StreamResponse:
+    pii = request.app.get("pii_checker")
+    if pii is not None:
+        blocked = await pii.check(request)
+        if blocked is not None:
+            return blocked
+    return await route_general_request(request, "/v1/completions")
+
+
+async def handle_embeddings(request: web.Request) -> web.StreamResponse:
+    return await route_general_request(request, "/v1/embeddings")
+
+
+async def handle_rerank(request: web.Request) -> web.StreamResponse:
+    return await route_general_request(request, "/v1/rerank")
+
+
+async def handle_models(request: web.Request) -> web.Response:
+    cards = {}
+    for ep in get_service_discovery().get_endpoint_info():
+        for name in ep.model_names:
+            if name not in cards:
+                cards[name] = ModelCard(id=name)
+    return web.json_response(ModelList(data=list(cards.values())).to_dict())
+
+
+async def handle_health(request: web.Request) -> web.Response:
+    problems = []
+    if not get_service_discovery().get_health():
+        problems.append("service discovery is down")
+    if not get_engine_stats_scraper().get_health():
+        problems.append("engine stats scraper is down")
+    if problems:
+        return web.json_response({"status": "unhealthy",
+                                  "problems": problems}, status=503)
+    payload = {"status": "healthy"}
+    watcher = get_dynamic_config_watcher()
+    if watcher is not None:
+        payload["dynamic_config"] = watcher.get_current_config()
+    return web.json_response(payload)
+
+
+async def handle_metrics(request: web.Request) -> web.Response:
+    from prometheus_client import generate_latest, CONTENT_TYPE_LATEST
+
+    # Refresh gauges from both stats planes (reference metrics_router.py:38-78).
+    engine_stats = get_engine_stats_scraper().get_engine_stats()
+    request_stats = get_request_stats_monitor().get_request_stats(time.time())
+    for url, es in engine_stats.items():
+        metrics.num_requests_running.labels(server=url).set(
+            es.num_running_requests)
+        metrics.num_requests_waiting.labels(server=url).set(
+            es.num_queuing_requests)
+        metrics.gpu_cache_usage_perc.labels(server=url).set(
+            es.gpu_cache_usage_perc)
+        metrics.gpu_prefix_cache_hit_rate.labels(server=url).set(
+            es.gpu_prefix_cache_hit_rate)
+    for url, rs in request_stats.items():
+        metrics.current_qps.labels(server=url).set(rs.qps)
+        metrics.avg_decoding_length.labels(server=url).set(
+            rs.avg_decoding_length)
+        metrics.num_prefill_requests.labels(server=url).set(
+            rs.in_prefill_requests)
+        metrics.num_decoding_requests.labels(server=url).set(
+            rs.in_decoding_requests)
+        metrics.avg_latency.labels(server=url).set(rs.avg_latency)
+        metrics.avg_itl.labels(server=url).set(rs.avg_itl)
+        metrics.num_requests_swapped.labels(server=url).set(
+            rs.num_swapped_requests)
+    metrics.healthy_pods_total.labels(server="router").set(
+        len(get_service_discovery().get_endpoint_info())
+    )
+    return web.Response(body=generate_latest(),
+                        content_type=CONTENT_TYPE_LATEST.split(";")[0])
+
+
+# ----------------------------------------------------------- files / batches
+async def handle_file_upload(request: web.Request) -> web.Response:
+    storage = request.app.get("storage")
+    if storage is None:
+        return _error(501, "Files API disabled (--enable-batch-api)")
+    reader = await request.multipart()
+    filename, content, purpose = "upload", b"", "batch"
+    async for part in reader:
+        if part.name == "file":
+            filename = part.filename or filename
+            content = await part.read()
+        elif part.name == "purpose":
+            purpose = (await part.read()).decode()
+    info = await storage.save_file(filename, content, purpose=purpose)
+    return web.json_response(info.to_dict())
+
+
+async def handle_file_get(request: web.Request) -> web.Response:
+    storage = request.app.get("storage")
+    if storage is None:
+        return _error(501, "Files API disabled (--enable-batch-api)")
+    try:
+        info = await storage.get_file(request.match_info["file_id"])
+    except FileNotFoundError:
+        return _error(404, "File not found")
+    return web.json_response(info.to_dict())
+
+
+async def handle_file_content(request: web.Request) -> web.Response:
+    storage = request.app.get("storage")
+    if storage is None:
+        return _error(501, "Files API disabled (--enable-batch-api)")
+    try:
+        content = await storage.get_file_content(request.match_info["file_id"])
+    except FileNotFoundError:
+        return _error(404, "File not found")
+    return web.Response(body=content,
+                        content_type="application/octet-stream")
+
+
+async def handle_batch_create(request: web.Request) -> web.Response:
+    processor = request.app.get("batch_processor")
+    if processor is None:
+        return _error(501, "Batch API disabled (--enable-batch-api)")
+    body = await request.json()
+    if "input_file_id" not in body:
+        return _error(400, "Missing 'input_file_id'")
+    info = await processor.create_batch(
+        input_file_id=body["input_file_id"],
+        endpoint=body.get("endpoint", "/v1/chat/completions"),
+        completion_window=body.get("completion_window", "24h"),
+        metadata=body.get("metadata"),
+    )
+    return web.json_response(info.to_dict())
+
+
+async def handle_batch_get(request: web.Request) -> web.Response:
+    processor = request.app.get("batch_processor")
+    if processor is None:
+        return _error(501, "Batch API disabled (--enable-batch-api)")
+    info = await processor.retrieve_batch(request.match_info["batch_id"])
+    if info is None:
+        return _error(404, "Batch not found")
+    return web.json_response(info.to_dict())
+
+
+async def handle_batch_list(request: web.Request) -> web.Response:
+    processor = request.app.get("batch_processor")
+    if processor is None:
+        return _error(501, "Batch API disabled (--enable-batch-api)")
+    batches = await processor.list_batches()
+    return web.json_response(
+        {"object": "list", "data": [b.to_dict() for b in batches]}
+    )
+
+
+async def handle_batch_cancel(request: web.Request) -> web.Response:
+    processor = request.app.get("batch_processor")
+    if processor is None:
+        return _error(501, "Batch API disabled (--enable-batch-api)")
+    info = await processor.cancel_batch(request.match_info["batch_id"])
+    if info is None:
+        return _error(404, "Batch not found")
+    return web.json_response(info.to_dict())
+
+
+# ------------------------------------------------------------------- wiring
+def initialize_all(app: web.Application, args) -> None:
+    """Wire all router singletons (reference app.py:98-211 order)."""
+    if args.service_discovery == "static":
+        urls = parse_static_urls(args.static_backends)
+        models = [[m] for m in parse_static_model_names(args.static_models)]
+        if len(models) == 1 and len(urls) > 1:
+            models = models * len(urls)
+        initialize_service_discovery("static", urls=urls, models=models)
+    else:
+        initialize_service_discovery(
+            "k8s", namespace=args.k8s_namespace, port=args.k8s_port,
+            label_selector=args.k8s_label_selector,
+        )
+    initialize_engine_stats_scraper(args.engine_stats_interval)
+    initialize_request_stats_monitor(args.request_stats_window)
+    initialize_routing_logic(
+        args.routing_logic, session_key=args.session_key,
+        block_reuse_timeout=args.block_reuse_timeout,
+    )
+    gates = initialize_feature_gates(args.feature_gates)
+
+    if gates.enabled(SEMANTIC_CACHE):
+        from production_stack_tpu.router.semantic_cache import SemanticCache
+
+        app["semantic_cache"] = SemanticCache()
+    if gates.enabled(PII_DETECTION):
+        from production_stack_tpu.router.pii import PIIChecker
+
+        app["pii_checker"] = PIIChecker()
+
+    if args.enable_batch_api:
+        import os
+
+        from production_stack_tpu.router.files_service import (
+            DEFAULT_STORAGE_PATH,
+        )
+
+        storage_path = args.file_storage_path or DEFAULT_STORAGE_PATH
+        storage = initialize_storage(args.file_storage_class, storage_path)
+        app["storage"] = storage
+
+        async def send_fn(endpoint: str, body: dict) -> dict:
+            return await _inprocess_request(app, endpoint, body)
+
+        app["batch_processor"] = LocalBatchProcessor(
+            storage, db_path=os.path.join(storage_path, "batch.db"),
+            send_fn=send_fn,
+        )
+
+    app["rewriter"] = get_request_rewriter(args.request_rewriter)
+    if args.callbacks:
+        app["callbacks"] = initialize_custom_callbacks(args.callbacks)
+    if args.dynamic_config_json:
+        initialize_dynamic_config_watcher(args.dynamic_config_json)
+
+
+async def _inprocess_request(app: web.Application, endpoint: str,
+                             body: dict) -> dict:
+    """Run one request through routing + backend for the batch processor."""
+    import json as _json
+
+    from production_stack_tpu.router.request_service import RoutedRequest
+    from production_stack_tpu.router.stats import (
+        get_engine_stats_scraper as scraper,
+        get_request_stats_monitor as monitor,
+    )
+
+    model = body.get("model")
+    endpoints = [
+        ep for ep in get_service_discovery().get_endpoint_info()
+        if not ep.model_names or model in ep.model_names
+    ]
+    if not endpoints:
+        raise RuntimeError(f"No backend serves model {model!r}")
+    url = get_routing_logic().route_request(
+        endpoints, scraper().get_engine_stats(),
+        monitor().get_request_stats(time.time()),
+        RoutedRequest({}, body),
+    )
+    session = app["client_session"]
+    async with session.post(f"{url}{endpoint}", json=body) as resp:
+        return _json.loads(await resp.read())
+
+
+def build_app(args) -> web.Application:
+    app = web.Application(client_max_size=64 * 1024 * 1024)
+    initialize_all(app, args)
+
+    async def on_startup(app):
+        app["client_session"] = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=None, sock_connect=30),
+            connector=aiohttp.TCPConnector(limit=0),  # unlimited, like ref
+        )
+        proc = app.get("batch_processor")
+        if proc is not None:
+            proc.start()
+
+    async def on_cleanup(app):
+        proc = app.get("batch_processor")
+        if proc is not None:
+            await proc.stop()
+        await app["client_session"].close()
+        get_engine_stats_scraper().close()
+        get_service_discovery().close()
+
+    app.on_startup.append(on_startup)
+    app.on_cleanup.append(on_cleanup)
+
+    app.router.add_post("/v1/chat/completions", handle_chat_completions)
+    app.router.add_post("/v1/completions", handle_completions)
+    app.router.add_post("/v1/embeddings", handle_embeddings)
+    app.router.add_post("/v1/rerank", handle_rerank)
+    app.router.add_get("/v1/models", handle_models)
+    app.router.add_get("/health", handle_health)
+    app.router.add_get("/metrics", handle_metrics)
+    app.router.add_post("/v1/files", handle_file_upload)
+    app.router.add_get("/v1/files/{file_id}", handle_file_get)
+    app.router.add_get("/v1/files/{file_id}/content", handle_file_content)
+    app.router.add_post("/v1/batches", handle_batch_create)
+    app.router.add_get("/v1/batches", handle_batch_list)
+    app.router.add_get("/v1/batches/{batch_id}", handle_batch_get)
+    app.router.add_post("/v1/batches/{batch_id}/cancel", handle_batch_cancel)
+    return app
+
+
+def main(argv=None) -> None:
+    from production_stack_tpu.router.parser import parse_args
+
+    args = parse_args(argv)
+    set_ulimit()
+    app = build_app(args)
+
+    if args.log_stats:
+        from production_stack_tpu.router.log_stats import start_log_stats
+
+        start_log_stats(args.log_stats_interval)
+
+    logger.info("Router listening on %s:%d", args.host, args.port)
+    web.run_app(app, host=args.host, port=args.port, print=None)
+
+
+if __name__ == "__main__":
+    main()

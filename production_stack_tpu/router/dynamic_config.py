@@ -1,0 +1,128 @@
+"""Dynamic router config: watch a JSON file, hot-swap discovery + routing.
+
+Contract parity with reference src/vllm_router/dynamic_config.py:
+  * ``DynamicRouterConfig`` mirrors the JSON schema the Go StaticRoute
+    operator renders into its ConfigMap (:34-90; operator side
+    staticroute_controller.go:134-184).
+  * ``DynamicConfigWatcher`` polls the file every `watch_interval`, diffs,
+    and applies by swapping the discovery/routing singletons in place
+    (:93-223); current state is surfaced via /health (:216-223).
+"""
+
+import dataclasses
+import json
+import threading
+import time
+from typing import List, Optional
+
+from production_stack_tpu.utils import (
+    init_logger,
+    parse_static_model_names,
+    parse_static_urls,
+)
+
+logger = init_logger(__name__)
+
+
+@dataclasses.dataclass
+class DynamicRouterConfig:
+    service_discovery: Optional[str] = None
+    routing_logic: Optional[str] = None
+    static_backends: Optional[str] = None
+    static_models: Optional[str] = None
+    session_key: Optional[str] = None
+    k8s_namespace: Optional[str] = None
+    k8s_port: Optional[int] = None
+    k8s_label_selector: Optional[str] = None
+
+    @staticmethod
+    def from_json(path: str) -> "DynamicRouterConfig":
+        with open(path) as f:
+            raw = json.load(f)
+        fields = {f.name for f in dataclasses.fields(DynamicRouterConfig)}
+        return DynamicRouterConfig(
+            **{k: v for k, v in raw.items() if k in fields}
+        )
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class DynamicConfigWatcher:
+    def __init__(self, config_path: str, watch_interval: float = 10.0):
+        self.config_path = config_path
+        self.watch_interval = watch_interval
+        self.current_config: Optional[DynamicRouterConfig] = None
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._watch_worker, daemon=True, name="dynamic-config-watcher"
+        )
+        self._thread.start()
+
+    def _watch_worker(self) -> None:
+        while self._running:
+            try:
+                config = DynamicRouterConfig.from_json(self.config_path)
+                if self.current_config is None or \
+                        config != self.current_config:
+                    logger.info("Dynamic config changed; applying %s",
+                                config.to_dict())
+                    self._apply(config)
+                    self.current_config = config
+            except FileNotFoundError:
+                pass
+            except Exception:  # noqa: BLE001 — watcher must survive bad JSON
+                logger.exception("Failed to load dynamic config")
+            time.sleep(self.watch_interval)
+
+    def _apply(self, config: DynamicRouterConfig) -> None:
+        from production_stack_tpu.router.routing_logic import (
+            reconfigure_routing_logic,
+        )
+        from production_stack_tpu.router.service_discovery import (
+            reconfigure_service_discovery,
+        )
+
+        if config.service_discovery == "static":
+            urls = parse_static_urls(config.static_backends or "")
+            models = [
+                [m] for m in parse_static_model_names(config.static_models or "")
+            ]
+            reconfigure_service_discovery("static", urls=urls, models=models)
+        elif config.service_discovery == "k8s":
+            reconfigure_service_discovery(
+                "k8s",
+                namespace=config.k8s_namespace or "default",
+                port=config.k8s_port or 8000,
+                label_selector=config.k8s_label_selector,
+            )
+        if config.routing_logic:
+            reconfigure_routing_logic(
+                config.routing_logic, session_key=config.session_key
+            )
+
+    def get_current_config(self) -> Optional[dict]:
+        return self.current_config.to_dict() if self.current_config else None
+
+    def get_health(self) -> bool:
+        return self._thread.is_alive()
+
+    def close(self) -> None:
+        self._running = False
+
+
+_watcher: Optional[DynamicConfigWatcher] = None
+
+
+def initialize_dynamic_config_watcher(
+    config_path: str, watch_interval: float = 10.0
+) -> DynamicConfigWatcher:
+    global _watcher
+    if _watcher is not None:
+        _watcher.close()
+    _watcher = DynamicConfigWatcher(config_path, watch_interval)
+    return _watcher
+
+
+def get_dynamic_config_watcher() -> Optional[DynamicConfigWatcher]:
+    return _watcher

@@ -1,0 +1,58 @@
+"""Router-exported Prometheus gauges.
+
+Same series names as reference src/vllm_router/services/metrics_service/__init__.py:5-32
+so the shipped Grafana dashboard works unchanged, plus the two series the
+reference dashboard charts but never emits (SURVEY.md §5 observability):
+``vllm:router_queueing_delay_seconds`` and ``vllm:avg_prefill_length`` —
+here they are actually emitted.
+"""
+
+from prometheus_client import Gauge
+
+num_requests_running = Gauge(
+    "vllm:num_requests_running",
+    "Number of running requests per engine", ["server"],
+)
+num_requests_waiting = Gauge(
+    "vllm:num_requests_waiting",
+    "Number of waiting requests per engine", ["server"],
+)
+current_qps = Gauge(
+    "vllm:current_qps", "Router-observed QPS per engine", ["server"],
+)
+avg_decoding_length = Gauge(
+    "vllm:avg_decoding_length", "Average decoding length per engine", ["server"],
+)
+num_prefill_requests = Gauge(
+    "vllm:num_prefill_requests", "In-prefill requests per engine", ["server"],
+)
+num_decoding_requests = Gauge(
+    "vllm:num_decoding_requests", "In-decode requests per engine", ["server"],
+)
+healthy_pods_total = Gauge(
+    "vllm:healthy_pods_total", "Healthy engine pods per server label", ["server"],
+)
+avg_latency = Gauge(
+    "vllm:avg_latency", "Average end-to-end latency per engine", ["server"],
+)
+avg_itl = Gauge(
+    "vllm:avg_itl", "Average inter-token latency per engine", ["server"],
+)
+num_requests_swapped = Gauge(
+    "vllm:num_requests_swapped", "Swapped-out requests per engine", ["server"],
+)
+gpu_cache_usage_perc = Gauge(
+    "vllm:gpu_cache_usage_perc",
+    "KV-pool usage fraction per engine (TPU HBM)", ["server"],
+)
+gpu_prefix_cache_hit_rate = Gauge(
+    "vllm:gpu_prefix_cache_hit_rate",
+    "Per-interval prefix-cache hit rate per engine", ["server"],
+)
+router_queueing_delay_seconds = Gauge(
+    "vllm:router_queueing_delay_seconds",
+    "Router-side queueing delay (route decision to backend connect)", ["server"],
+)
+avg_prefill_length = Gauge(
+    "vllm:avg_prefill_length", "Average prompt length per engine", ["server"],
+)

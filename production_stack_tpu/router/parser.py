@@ -1,0 +1,72 @@
+"""Router CLI flags + cross-field validation.
+
+Flag names match reference src/vllm_router/parsers/parser.py:58-225 so Helm
+templates and operator-rendered configs carry over unchanged; validation
+rules mirror :30-55.
+"""
+
+import argparse
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(description="TPU production-stack router")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8000)
+
+    p.add_argument("--service-discovery", choices=["static", "k8s"],
+                   required=True)
+    p.add_argument("--static-backends", default=None,
+                   help="comma-separated backend URLs (static discovery)")
+    p.add_argument("--static-models", default=None,
+                   help="comma-separated model names, one entry per backend")
+    p.add_argument("--k8s-namespace", default="default")
+    p.add_argument("--k8s-port", type=int, default=8000)
+    p.add_argument("--k8s-label-selector", default=None)
+
+    p.add_argument("--routing-logic", default="roundrobin",
+                   choices=["roundrobin", "session",
+                            "cache_aware_load_balancing"])
+    p.add_argument("--session-key", default=None)
+    p.add_argument("--block-reuse-timeout", type=float, default=300.0,
+                   help="cache-aware router: seconds a session's KV blocks "
+                        "are assumed to stay resident")
+
+    p.add_argument("--engine-stats-interval", type=float, default=10.0)
+    p.add_argument("--request-stats-window", type=float, default=60.0)
+    p.add_argument("--log-stats", action="store_true")
+    p.add_argument("--log-stats-interval", type=float, default=10.0)
+
+    p.add_argument("--dynamic-config-json", default=None)
+    p.add_argument("--feature-gates", default="",
+                   help="comma-separated Name=true|false gates")
+
+    p.add_argument("--enable-batch-api", action="store_true")
+    p.add_argument("--file-storage-class", default="local_file")
+    p.add_argument("--file-storage-path", default=None)
+    p.add_argument("--batch-processor", default="local")
+
+    p.add_argument("--request-rewriter", default="noop")
+    p.add_argument("--callbacks", default="",
+                   help="dotted path to a callbacks instance")
+    args = p.parse_args(argv)
+    validate_args(args)
+    return args
+
+
+def validate_args(args: argparse.Namespace) -> None:
+    if args.service_discovery == "static":
+        if not args.static_backends:
+            raise ValueError(
+                "--static-backends required with --service-discovery static"
+            )
+        if not args.static_models:
+            raise ValueError(
+                "--static-models required with --service-discovery static"
+            )
+    if args.routing_logic in ("session", "cache_aware_load_balancing") \
+            and not args.session_key:
+        # cache_aware without a session key would silently degrade to pure
+        # load scoring (its KV-affinity core disabled) — fail fast instead.
+        raise ValueError(
+            f"--session-key required with --routing-logic {args.routing_logic}"
+        )

@@ -1,0 +1,127 @@
+"""PII detection middleware (feature-gated).
+
+Contract parity with reference src/vllm_router/experimental/pii/: request
+bodies are scanned before routing; matches can BLOCK (400 with the detected
+types) or REDACT in place (middleware.py:103-154, types.py). The regex
+analyzer ships; Presidio is not in this image, so the analyzer factory only
+exposes "regex" (the interface accepts others).
+"""
+
+import enum
+import json
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Pattern
+
+from aiohttp import web
+from prometheus_client import Counter
+
+from production_stack_tpu.protocols import ErrorResponse
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+pii_requests_total = Counter(
+    "vllm:pii_requests_scanned", "Requests scanned for PII"
+)
+pii_detections_total = Counter(
+    "vllm:pii_detections", "PII entities detected", ["pii_type"]
+)
+pii_blocked_total = Counter(
+    "vllm:pii_requests_blocked", "Requests blocked due to PII"
+)
+
+
+class PIIType(str, enum.Enum):
+    EMAIL = "email"
+    PHONE = "phone"
+    SSN = "ssn"
+    CREDIT_CARD = "credit_card"
+    IP_ADDRESS = "ip_address"
+    API_KEY = "api_key"
+
+
+class PIIAction(str, enum.Enum):
+    BLOCK = "block"
+    REDACT = "redact"
+
+
+_PATTERNS: Dict[PIIType, Pattern] = {
+    PIIType.EMAIL: re.compile(
+        r"\b[A-Za-z0-9._%+-]+@[A-Za-z0-9.-]+\.[A-Za-z]{2,}\b"
+    ),
+    PIIType.PHONE: re.compile(
+        r"\b(?:\+?\d{1,3}[-.\s]?)?\(?\d{3}\)?[-.\s]?\d{3}[-.\s]?\d{4}\b"
+    ),
+    PIIType.SSN: re.compile(r"\b\d{3}-\d{2}-\d{4}\b"),
+    PIIType.CREDIT_CARD: re.compile(r"\b(?:\d[ -]?){13,16}\b"),
+    PIIType.IP_ADDRESS: re.compile(r"\b(?:\d{1,3}\.){3}\d{1,3}\b"),
+    PIIType.API_KEY: re.compile(r"\b(?:sk|pk|api)[-_][A-Za-z0-9]{16,}\b"),
+}
+
+
+@dataclass
+class PIIMatch:
+    pii_type: PIIType
+    start: int
+    end: int
+    text: str
+
+
+class RegexAnalyzer:
+    def __init__(self, types: Optional[List[PIIType]] = None):
+        self.types = types or list(PIIType)
+
+    def analyze(self, text: str) -> List[PIIMatch]:
+        out = []
+        for t in self.types:
+            for m in _PATTERNS[t].finditer(text):
+                out.append(PIIMatch(t, m.start(), m.end(), m.group()))
+        return out
+
+
+def create_analyzer(kind: str = "regex", **kwargs):
+    if kind == "regex":
+        return RegexAnalyzer(**kwargs)
+    raise ValueError(
+        f"Unknown PII analyzer {kind!r} (this build ships 'regex')"
+    )
+
+
+@dataclass
+class PIIChecker:
+    action: PIIAction = PIIAction.BLOCK
+    analyzer: object = field(default_factory=RegexAnalyzer)
+
+    async def check(self, request: web.Request) -> Optional[web.Response]:
+        """Scan message/prompt text; return a 400 response to block, or None
+        (after in-place redaction when action=redact)."""
+        try:
+            body = json.loads(await request.read())
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return None
+        pii_requests_total.inc()
+        texts = []
+        for m in body.get("messages", []) or []:
+            if isinstance(m.get("content"), str):
+                texts.append(m["content"])
+        prompt = body.get("prompt")
+        if isinstance(prompt, str):
+            texts.append(prompt)
+        matches = [m for t in texts for m in self.analyzer.analyze(t)]
+        if not matches:
+            return None
+        types = sorted({m.pii_type.value for m in matches})
+        for t in types:
+            pii_detections_total.labels(pii_type=t).inc()
+        if self.action == PIIAction.BLOCK:
+            pii_blocked_total.inc()
+            logger.warning("Blocked request containing PII: %s", types)
+            return web.json_response(
+                ErrorResponse(
+                    message=f"Request blocked: detected PII types {types}",
+                    type="pii_detected", code=400,
+                ).to_dict(),
+                status=400,
+            )
+        return None  # redact mode: handled by rewriter in a later phase

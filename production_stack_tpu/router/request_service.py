@@ -1,0 +1,177 @@
+"""Request proxy: model filtering, routing, streaming relay, stats hooks.
+
+Contract parity with reference src/vllm_router/services/request_service/request.py:
+  * ``route_general_request`` — body parse, callbacks.pre_request
+    short-circuit, model extraction + 400, rewriter hook, endpoint filtering
+    by model, routing decision, proxy (:144-231).
+  * ``process_request`` — async streaming relay with on_new_request /
+    on_request_response (TTFT at first chunk) / on_request_complete stats
+    hooks and semantic-cache store + callbacks.post_request on completion
+    (:58-141).
+
+Built on aiohttp client streams instead of httpx (not in this image); the
+response is relayed chunk-by-chunk so SSE token streaming works end-to-end.
+"""
+
+import json
+import time
+from typing import Optional
+
+from aiohttp import web
+
+from production_stack_tpu.router import metrics
+from production_stack_tpu.router.routing_logic import get_routing_logic
+from production_stack_tpu.router.service_discovery import get_service_discovery
+from production_stack_tpu.router.stats import (
+    get_engine_stats_scraper,
+    get_request_stats_monitor,
+)
+from production_stack_tpu.protocols import ErrorResponse, random_uuid
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+
+class RoutedRequest:
+    """Duck-typed view handed to RoutingInterface implementations."""
+
+    def __init__(self, headers, json_body):
+        self.headers = headers
+        self.json_body = json_body
+
+
+def _error(status: int, message: str, etype: str = "invalid_request_error"):
+    return web.json_response(
+        ErrorResponse(message=message, type=etype, code=status).to_dict(),
+        status=status,
+    )
+
+
+async def route_general_request(
+    request: web.Request, endpoint: str
+) -> web.StreamResponse:
+    """Proxy `request` to the backend chosen by the routing logic."""
+    app = request.app
+    in_time = time.time()
+    try:
+        body_bytes = await request.read()
+        body = json.loads(body_bytes) if body_bytes else {}
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        return _error(400, "Request body is not valid JSON")
+    request_id = request.headers.get("x-request-id") or random_uuid("cmpl-")
+
+    callbacks = app.get("callbacks")
+    if callbacks is not None:
+        short = await callbacks.pre_request(request, body, endpoint)
+        if short is not None:
+            return short
+
+    model = body.get("model")
+    if not model:
+        return _error(400, "Request body must contain a 'model' field")
+
+    rewriter = app.get("rewriter")
+    if rewriter is not None:
+        body = rewriter.rewrite(body, endpoint)
+
+    endpoints = get_service_discovery().get_endpoint_info()
+    endpoints = [
+        ep for ep in endpoints
+        if not ep.model_names or model in ep.model_names
+    ]
+    if not endpoints:
+        return _error(
+            404, f"Model '{model}' not served by any healthy backend",
+            etype="model_not_found",
+        )
+
+    engine_stats = get_engine_stats_scraper().get_engine_stats()
+    request_stats = get_request_stats_monitor().get_request_stats(time.time())
+    router = get_routing_logic()
+    backend_url = router.route_request(
+        endpoints, engine_stats, request_stats,
+        RoutedRequest(request.headers, body),
+    )
+    route_time = time.time()
+    metrics.router_queueing_delay_seconds.labels(server=backend_url).set(
+        route_time - in_time
+    )
+    logger.debug("Routing request %s for model %s to %s (%.1f ms)",
+                 request_id, model, backend_url, (route_time - in_time) * 1e3)
+    return await proxy_request(
+        request, backend_url, endpoint, json.dumps(body).encode(), request_id,
+        body=body,
+    )
+
+
+async def proxy_request(
+    request: web.Request,
+    backend_url: str,
+    endpoint: str,
+    payload: bytes,
+    request_id: str,
+    body: Optional[dict] = None,
+) -> web.StreamResponse:
+    """Stream the backend response through to the client."""
+    app = request.app
+    session = app["client_session"]
+    monitor = get_request_stats_monitor()
+    monitor.on_new_request(backend_url, request_id, time.time())
+
+    headers = {"Content-Type": "application/json"}
+    auth = request.headers.get("Authorization")
+    if auth:
+        headers["Authorization"] = auth
+
+    response: Optional[web.StreamResponse] = None
+    try:
+        async with session.post(
+            f"{backend_url}{endpoint}", data=payload, headers=headers
+        ) as backend_resp:
+            response = web.StreamResponse(
+                status=backend_resp.status,
+                headers={
+                    "Content-Type": backend_resp.headers.get(
+                        "Content-Type", "application/json"
+                    ),
+                    "x-request-id": request_id,
+                },
+            )
+            await response.prepare(request)
+            first = True
+            full_chunks = []
+            async for chunk in backend_resp.content.iter_any():
+                now = time.time()
+                if first:
+                    monitor.on_request_response(backend_url, request_id, now)
+                    first = False
+                else:
+                    monitor.on_request_token(backend_url, request_id, now)
+                if app.get("semantic_cache") is not None:
+                    full_chunks.append(chunk)
+                await response.write(chunk)
+            monitor.on_request_complete(backend_url, request_id, time.time())
+            await response.write_eof()
+    except Exception as e:  # noqa: BLE001 — backend connect/stream failure
+        monitor.on_request_complete(backend_url, request_id, time.time())
+        logger.warning("Proxy to %s failed: %s", backend_url, e)
+        if response is None or not response.prepared:
+            # Nothing sent yet: a clean 502 is still possible.
+            return _error(
+                502, f"Backend request failed: {e}", etype="bad_gateway"
+            )
+        # Headers/body already on the wire: abort the stream so the client
+        # sees truncation instead of a corrupted second response.
+        await response.write_eof()
+        return response
+
+    cache = app.get("semantic_cache")
+    if cache is not None and body is not None and backend_resp.status == 200:
+        try:
+            cache.store_response(body, b"".join(full_chunks))
+        except Exception:  # noqa: BLE001 — cache store is best-effort
+            logger.exception("Semantic cache store failed")
+    callbacks = app.get("callbacks")
+    if callbacks is not None:
+        await callbacks.post_request(request, body)
+    return response

@@ -1,0 +1,272 @@
+"""Pluggable request -> backend selection.
+
+Contract parity with reference src/vllm_router/routers/routing_logic.py:
+  * ``RoutingInterface.route_request(endpoints, engine_stats, request_stats,
+    request) -> url`` (:39-59).
+  * ``RoundRobinRouter`` (:62-93).
+  * ``SessionRouter`` — session-key consistent hashing with lowest-QPS
+    fallback for keyless requests; ring follows endpoint churn (:96-189).
+  * ``CacheAwareLoadBalancingRouter`` — the fork's addition (:211-421):
+    session -> engine KV-affinity map with TTL, predicted cache hit rate
+    blended with an engine load score; falls back to least-loaded.
+  * singleton initialize/reconfigure/get with in-place swap (:425-460).
+
+The `request` argument duck-types: anything with ``.headers`` (mapping) and
+``.json_body`` (dict) works — aiohttp requests and test fakes alike.
+"""
+
+import abc
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from production_stack_tpu.router.service_discovery import EndpointInfo
+from production_stack_tpu.router.stats.engine_stats import EngineStats
+from production_stack_tpu.router.stats.request_stats import RequestStats
+from production_stack_tpu.utils import SingletonABCMeta, init_logger
+from production_stack_tpu.utils.hashring import HashRing
+
+logger = init_logger(__name__)
+
+
+class RoutingLogic:
+    ROUND_ROBIN = "roundrobin"
+    SESSION = "session"
+    CACHE_AWARE_LB = "cache_aware_load_balancing"
+
+
+class RoutingInterface(metaclass=SingletonABCMeta):
+    @abc.abstractmethod
+    def route_request(
+        self,
+        endpoints: List[EndpointInfo],
+        engine_stats: Dict[str, EngineStats],
+        request_stats: Dict[str, RequestStats],
+        request,
+    ) -> str:
+        raise NotImplementedError
+
+
+class RoundRobinRouter(RoutingInterface):
+    def __init__(self, **_):
+        if hasattr(self, "_initialized"):
+            return
+        self._initialized = True
+        self.req_id = 0
+
+    def route_request(self, endpoints, engine_stats, request_stats, request) -> str:
+        if not endpoints:
+            raise ValueError("No available endpoints for routing")
+        chosen = sorted(endpoints, key=lambda e: e.url)[
+            self.req_id % len(endpoints)
+        ]
+        self.req_id += 1
+        return chosen.url
+
+
+class SessionRouter(RoutingInterface):
+    """Stable session->backend affinity via consistent hashing.
+
+    Keyless requests fall back to the lowest-QPS backend (reference
+    routing_logic.py:111-132) — this matters on TPU where pod startup takes
+    minutes, so spreading cold traffic by load beats hashing it.
+    """
+
+    def __init__(self, session_key: Optional[str] = None, **_):
+        if hasattr(self, "_initialized"):
+            return
+        self._initialized = True
+        if not session_key:
+            raise ValueError("SessionRouter requires --session-key")
+        self.session_key = session_key
+        self.hash_ring = HashRing()
+
+    def _sync_ring(self, endpoints: List[EndpointInfo]) -> None:
+        self.hash_ring.set_nodes([ep.url for ep in endpoints])
+
+    @staticmethod
+    def _qps_routing(endpoints, request_stats) -> str:
+        best_url, best_qps = None, float("inf")
+        for ep in endpoints:
+            qps = request_stats[ep.url].qps if ep.url in request_stats else -1
+            if qps < best_qps:
+                best_url, best_qps = ep.url, qps
+        return best_url
+
+    def route_request(self, endpoints, engine_stats, request_stats, request) -> str:
+        if not endpoints:
+            raise ValueError("No available endpoints for routing")
+        self._sync_ring(endpoints)
+        session_id = None
+        headers = getattr(request, "headers", None)
+        if headers is not None:
+            session_id = headers.get(self.session_key)
+        if not session_id:
+            return self._qps_routing(endpoints, request_stats)
+        return self.hash_ring.get_node(str(session_id))
+
+
+class LRUCache:
+    """Bounded mapping with recency eviction (reference routing_logic.py:192-208)."""
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = capacity
+        self._data: OrderedDict = OrderedDict()
+
+    def get(self, key):
+        if key not in self._data:
+            return None
+        self._data.move_to_end(key)
+        return self._data[key]
+
+    def put(self, key, value) -> None:
+        if key in self._data:
+            self._data.move_to_end(key)
+        self._data[key] = value
+        while len(self._data) > self.capacity:
+            self._data.popitem(last=False)
+
+    def __contains__(self, key) -> bool:
+        return key in self._data
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+
+class CacheAwareLoadBalancingRouter(RoutingInterface):
+    """Blend predicted KV-cache reuse with engine load (fork addition,
+    reference routing_logic.py:211-421).
+
+    A session's KV blocks live on the engine that served it last, for roughly
+    ``block_reuse_timeout`` seconds (until evicted). Routing a returning
+    session back there predicts a prefix-cache hit; but an overloaded engine
+    can cost more than the recompute, so the decision blends:
+        score = w_cache * predicted_hit_rate - w_load * load_score
+    and the best-scoring engine wins. Sessions without affinity (or whose
+    blocks likely expired) go to the least-loaded engine.
+    """
+
+    def __init__(
+        self,
+        session_key: Optional[str] = None,
+        block_reuse_timeout: float = 300.0,
+        cache_weight: float = 0.6,
+        load_weight: float = 0.4,
+        **_,
+    ):
+        if hasattr(self, "_initialized"):
+            return
+        self._initialized = True
+        self.session_key = session_key
+        self.block_reuse_timeout = block_reuse_timeout
+        self.cache_weight = cache_weight
+        self.load_weight = load_weight
+        # session -> (engine_url, last_seen_ts)
+        self._affinity = LRUCache(capacity=8192)
+        self._rr = 0
+
+    # ------------------------------------------------------------- components
+    def _predict_cache_hit_rate(self, session_id, url: str,
+                                engine_stats: Dict[str, EngineStats]) -> float:
+        """P(prefix KV still resident on `url` for this session)."""
+        if session_id is None:
+            return 0.0
+        entry = self._affinity.get(session_id)
+        if entry is None or entry[0] != url:
+            return 0.0
+        age = time.time() - entry[1]
+        if age >= self.block_reuse_timeout:
+            return 0.0
+        # Fresh sessions predict near-certain reuse, decaying with age and
+        # discounted by cache pressure (a full KV pool evicts sooner).
+        p = 1.0 - age / self.block_reuse_timeout
+        stats = engine_stats.get(url)
+        if stats is not None and stats.gpu_cache_usage_perc > 0.9:
+            p *= 0.5
+        return p
+
+    @staticmethod
+    def _engine_load_score(url: str,
+                           engine_stats: Dict[str, EngineStats],
+                           request_stats: Dict[str, RequestStats]) -> float:
+        """0 (idle) .. ~1 (saturated)."""
+        score = 0.0
+        es = engine_stats.get(url)
+        if es is not None:
+            score += min(es.num_running_requests / 16.0, 1.0) * 0.4
+            score += min(es.num_queuing_requests / 8.0, 1.0) * 0.4
+            score += es.gpu_cache_usage_perc * 0.2
+        rs = request_stats.get(url)
+        if rs is not None and rs.qps > 0:
+            score += min(rs.qps / 32.0, 1.0) * 0.2
+        return score
+
+    # --------------------------------------------------------------- routing
+    def route_request(self, endpoints, engine_stats, request_stats, request) -> str:
+        if not endpoints:
+            raise ValueError("No available endpoints for routing")
+        session_id = None
+        headers = getattr(request, "headers", None)
+        if headers is not None and self.session_key:
+            session_id = headers.get(self.session_key)
+
+        best_url, best_score = None, float("-inf")
+        for ep in sorted(endpoints, key=lambda e: e.url):
+            hit = self._predict_cache_hit_rate(session_id, ep.url, engine_stats)
+            load = self._engine_load_score(ep.url, engine_stats, request_stats)
+            score = self.cache_weight * hit - self.load_weight * load
+            if score > best_score:
+                best_url, best_score = ep.url, score
+
+        if best_url is None:  # all scores -inf (cannot happen, but be safe)
+            best_url = endpoints[self._rr % len(endpoints)].url
+            self._rr += 1
+        if session_id is not None:
+            self._affinity.put(session_id, (best_url, time.time()))
+        return best_url
+
+
+_ROUTERS = {
+    RoutingLogic.ROUND_ROBIN: RoundRobinRouter,
+    RoutingLogic.SESSION: SessionRouter,
+    RoutingLogic.CACHE_AWARE_LB: CacheAwareLoadBalancingRouter,
+}
+
+
+def initialize_routing_logic(routing_logic: str, **kwargs) -> RoutingInterface:
+    cls = _ROUTERS.get(routing_logic)
+    if cls is None:
+        raise ValueError(f"Invalid routing logic: {routing_logic!r}")
+    logger.info("Initializing routing logic: %s", routing_logic)
+    return cls(**kwargs)
+
+
+def reconfigure_routing_logic(routing_logic: str, **kwargs) -> RoutingInterface:
+    """Swap the active routing logic in place (reference routing_logic.py:445-452).
+
+    Construct-then-swap: the replacement is fully built BEFORE the registry
+    is touched, so a bad config (e.g. session without session_key) raises
+    without leaving routing uninitialized, and in-flight requests never
+    observe an empty registry for more than the GIL-atomic swap below.
+    """
+    from production_stack_tpu.utils import SingletonMeta
+
+    cls = _ROUTERS.get(routing_logic)
+    if cls is None:
+        raise ValueError(f"Invalid routing logic: {routing_logic!r}")
+    new = cls.__new__(cls)      # bypass the singleton cache
+    new.__init__(**kwargs)      # may raise; registry still intact
+    for c in _ROUTERS.values():
+        SingletonMeta._instances.pop(c, None)
+    SingletonMeta._instances[cls] = new
+    logger.info("Reconfigured routing logic: %s", routing_logic)
+    return new
+
+
+def get_routing_logic() -> RoutingInterface:
+    from production_stack_tpu.utils import SingletonMeta
+
+    for cls in _ROUTERS.values():
+        if cls in SingletonMeta._instances:
+            return SingletonMeta._instances[cls]
+    raise RuntimeError("Routing logic not initialized")

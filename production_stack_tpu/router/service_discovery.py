@@ -1,0 +1,259 @@
+"""Backend endpoint discovery: static list or live Kubernetes pod watch.
+
+Contract parity with reference src/vllm_router/service_discovery.py:
+  * ``EndpointInfo`` records url + model names + added timestamp (:21-47).
+  * ``StaticServiceDiscovery`` serves a fixed url/model list (:78-96).
+  * ``K8sServiceDiscovery`` watches labeled pods, gates on readiness, probes
+    each pod's /v1/models for its served models (:99-281).
+  * module-level initialize/get/reconfigure singletons (:307-351).
+
+TPU-shaped differences: the K8s watch speaks to the API server over raw
+HTTPS (this image has no `kubernetes` client package) using the in-cluster
+service-account token, and the model probe is async aiohttp rather than a
+blocking `requests` call per pod event.
+"""
+
+import asyncio
+import json
+import ssl
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+_SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+@dataclass
+class EndpointInfo:
+    url: str
+    model_names: List[str] = field(default_factory=list)
+    added_timestamp: float = field(default_factory=time.time)
+    pod_name: Optional[str] = None
+
+    # Back-compat alias: parts of the reference treat this as a single name
+    # (reference service_discovery.py:30-47 stores `model_name`).
+    @property
+    def model_name(self) -> Optional[str]:
+        return self.model_names[0] if self.model_names else None
+
+
+class ServiceDiscovery:
+    def get_endpoint_info(self) -> List[EndpointInfo]:
+        raise NotImplementedError
+
+    def get_health(self) -> bool:
+        return True
+
+    def close(self) -> None:
+        pass
+
+
+class StaticServiceDiscovery(ServiceDiscovery):
+    """Fixed backend list from --static-backends/--static-models."""
+
+    def __init__(self, urls: List[str], models: List[List[str]]):
+        assert len(urls) == len(models), (urls, models)
+        self._endpoints = [
+            EndpointInfo(url=u, model_names=list(m))
+            for u, m in zip(urls, models)
+        ]
+
+    def get_endpoint_info(self) -> List[EndpointInfo]:
+        return list(self._endpoints)
+
+
+class K8sPodIPServiceDiscovery(ServiceDiscovery):
+    """Watch labeled pods via the Kubernetes API; serve ready pods only.
+
+    A daemon thread runs the watch stream (reference pattern
+    service_discovery.py:131,171-196) and keeps `_endpoints` fresh under a
+    lock; readiness transitions add/remove endpoints so failed engines stop
+    receiving traffic (the stack's elasticity story, SURVEY.md §5).
+    """
+
+    def __init__(
+        self,
+        namespace: str = "default",
+        port: int = 8000,
+        label_selector: Optional[str] = None,
+        api_base: Optional[str] = None,
+        token: Optional[str] = None,
+        probe_models: bool = True,
+    ):
+        self.namespace = namespace
+        self.port = port
+        self.label_selector = label_selector
+        self.probe_models = probe_models
+        self._api_base = api_base or self._in_cluster_api_base()
+        self._token = token if token is not None else self._read_sa_token()
+        self._endpoints: Dict[str, EndpointInfo] = {}
+        self._lock = threading.Lock()
+        self._running = True
+        self._watch_alive = time.time()
+        self._thread = threading.Thread(
+            target=self._watch_loop, daemon=True, name="k8s-discovery"
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------- k8s plumbing
+    @staticmethod
+    def _in_cluster_api_base() -> str:
+        import os
+        host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        return f"https://{host}:{port}"
+
+    @staticmethod
+    def _read_sa_token() -> Optional[str]:
+        try:
+            with open(f"{_SA_DIR}/token") as f:
+                return f.read().strip()
+        except OSError:
+            return None
+
+    def _ssl_context(self):
+        try:
+            return ssl.create_default_context(cafile=f"{_SA_DIR}/ca.crt")
+        except (OSError, ssl.SSLError):
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            return ctx
+
+    # ------------------------------------------------------------- watch loop
+    def _watch_loop(self) -> None:
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        while self._running:
+            try:
+                loop.run_until_complete(self._watch_once())
+            except Exception as e:  # noqa: BLE001 — stream must self-heal
+                logger.warning("K8s watch stream error: %s; retrying", e)
+                time.sleep(0.5)
+        loop.close()
+
+    async def _watch_once(self) -> None:
+        import aiohttp
+
+        params = {"watch": "true", "timeoutSeconds": "30"}
+        if self.label_selector:
+            params["labelSelector"] = self.label_selector
+        headers = {}
+        if self._token:
+            headers["Authorization"] = f"Bearer {self._token}"
+        url = f"{self._api_base}/api/v1/namespaces/{self.namespace}/pods"
+        conn_kwargs = {}
+        if url.startswith("https"):
+            conn_kwargs["ssl"] = self._ssl_context()
+        timeout = aiohttp.ClientTimeout(total=None, sock_read=60)
+        # Pod event objects routinely exceed aiohttp's 64KiB line default
+        # (managedFields, env, volumes); a too-small buffer would wedge the
+        # watch in a reconnect loop on the same oversized event.
+        async with aiohttp.ClientSession(
+            timeout=timeout, read_bufsize=4 * 1024 * 1024
+        ) as session:
+            async with session.get(
+                url, params=params, headers=headers, **conn_kwargs
+            ) as resp:
+                resp.raise_for_status()
+                async for line in resp.content:
+                    if not self._running:
+                        return
+                    self._watch_alive = time.time()
+                    line = line.strip()
+                    if not line:
+                        continue
+                    event = json.loads(line)
+                    await self._on_pod_event(
+                        session, event.get("type"), event.get("object", {})
+                    )
+
+    @staticmethod
+    def _pod_ready(pod: dict) -> bool:
+        statuses = (pod.get("status") or {}).get("containerStatuses") or []
+        return bool(statuses) and all(s.get("ready") for s in statuses)
+
+    async def _probe_models(self, session, url: str) -> List[str]:
+        try:
+            async with session.get(f"{url}/v1/models", ssl=False) as resp:
+                data = await resp.json()
+                return [m["id"] for m in data.get("data", [])]
+        except Exception:  # noqa: BLE001 — pod may not be serving yet
+            return []
+
+    async def _on_pod_event(self, session, etype: str, pod: dict) -> None:
+        meta = pod.get("metadata") or {}
+        name = meta.get("name")
+        ip = (pod.get("status") or {}).get("podIP")
+        if not name:
+            return
+        ready = self._pod_ready(pod)
+        if etype == "DELETED" or not ready or not ip:
+            with self._lock:
+                if name in self._endpoints:
+                    logger.info("Discovery: removing engine %s", name)
+                    del self._endpoints[name]
+            return
+        url = f"http://{ip}:{self.port}"
+        models = (
+            await self._probe_models(session, url) if self.probe_models else []
+        )
+        with self._lock:
+            known = self._endpoints.get(name)
+            if known is None or known.url != url or known.model_names != models:
+                logger.info("Discovery: adding engine %s at %s (%s)",
+                            name, url, models)
+                self._endpoints[name] = EndpointInfo(
+                    url=url, model_names=models, pod_name=name
+                )
+
+    # -------------------------------------------------------------- interface
+    def get_endpoint_info(self) -> List[EndpointInfo]:
+        with self._lock:
+            return list(self._endpoints.values())
+
+    def get_health(self) -> bool:
+        # Healthy if the watch thread is alive and has heard from the API
+        # server recently (reference service_discovery.py:266-273).
+        return self._thread.is_alive() and time.time() - self._watch_alive < 120
+
+    def close(self) -> None:
+        self._running = False
+
+
+_service_discovery: Optional[ServiceDiscovery] = None
+
+
+def initialize_service_discovery(kind: str, **kwargs) -> ServiceDiscovery:
+    global _service_discovery
+    if _service_discovery is not None:
+        _service_discovery.close()
+    if kind == "static":
+        _service_discovery = StaticServiceDiscovery(
+            kwargs["urls"], kwargs["models"]
+        )
+    elif kind == "k8s":
+        _service_discovery = K8sPodIPServiceDiscovery(
+            namespace=kwargs.get("namespace", "default"),
+            port=kwargs.get("port", 8000),
+            label_selector=kwargs.get("label_selector"),
+            api_base=kwargs.get("api_base"),
+            token=kwargs.get("token"),
+        )
+    else:
+        raise ValueError(f"Unknown service discovery type: {kind!r}")
+    return _service_discovery
+
+
+def reconfigure_service_discovery(kind: str, **kwargs) -> ServiceDiscovery:
+    return initialize_service_discovery(kind, **kwargs)
+
+
+def get_service_discovery() -> ServiceDiscovery:
+    assert _service_discovery is not None, "service discovery not initialized"
+    return _service_discovery

@@ -1,0 +1,144 @@
+"""Engine /metrics scraper (pull plane).
+
+A daemon thread polls every discovered engine's Prometheus ``/metrics`` and
+parses the vllm-compatible series our TPU engines emit. Contract parity with
+reference src/vllm_router/stats/engine_stats.py:
+  * series parsed: ``vllm:num_requests_running``, ``vllm:num_requests_waiting``,
+    ``vllm:gpu_prefix_cache_hits_total`` / ``vllm:gpu_prefix_cache_queries_total``,
+    ``vllm:gpu_cache_usage_perc`` (:27-72, :128-139) — on TPU the "gpu" cache
+    series are reinterpreted as HBM KV-pool usage, same names so dashboards
+    and the cache-aware router work unchanged.
+  * per-interval hit-rate from counter DELTAS between consecutive scrapes
+    (:141-155, this fork's rewrite), not lifetime ratios.
+  * health = scrape thread recently completed a pass (:229-237).
+"""
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+from production_stack_tpu.router.service_discovery import get_service_discovery
+from production_stack_tpu.utils import SingletonMeta, init_logger
+
+logger = init_logger(__name__)
+
+
+@dataclass
+class EngineStats:
+    num_running_requests: int = 0
+    num_queuing_requests: int = 0
+    gpu_prefix_cache_hit_rate: float = 0.0   # per-interval (delta-based)
+    gpu_cache_usage_perc: float = 0.0        # TPU: HBM KV-pool usage
+    num_preemptions: int = 0
+
+    @staticmethod
+    def from_prometheus_text(text: str, prev: Optional[Tuple[float, float]] = None):
+        """Parse exposition text; returns (EngineStats, (hits, queries))."""
+        values: Dict[str, float] = {}
+        for line in text.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split()
+            if len(parts) < 2:
+                continue
+            name = parts[0].split("{")[0]
+            try:
+                values[name] = float(parts[-1])
+            except ValueError:
+                continue
+
+        hits = values.get("vllm:gpu_prefix_cache_hits_total", 0.0)
+        queries = values.get("vllm:gpu_prefix_cache_queries_total", 0.0)
+        if prev is not None:
+            dq = queries - prev[1]
+            dh = hits - prev[0]
+            hit_rate = dh / dq if dq > 0 else 0.0
+        else:
+            hit_rate = hits / queries if queries > 0 else 0.0
+        stats = EngineStats(
+            num_running_requests=int(values.get("vllm:num_requests_running", 0)),
+            num_queuing_requests=int(values.get("vllm:num_requests_waiting", 0)),
+            gpu_prefix_cache_hit_rate=hit_rate,
+            gpu_cache_usage_perc=values.get("vllm:gpu_cache_usage_perc", 0.0),
+            num_preemptions=int(values.get("vllm:num_preemptions_total", 0)),
+        )
+        return stats, (hits, queries)
+
+
+class EngineStatsScraper(metaclass=SingletonMeta):
+    def __init__(self, scrape_interval: float = 10.0):
+        if hasattr(self, "_initialized"):
+            return
+        self._initialized = True
+        self.scrape_interval = scrape_interval
+        self.engine_stats: Dict[str, EngineStats] = {}
+        self._prev_counters: Dict[str, Tuple[float, float]] = {}
+        self._lock = threading.Lock()
+        self._last_scrape = time.time()  # construction counts as a pass
+                                         # (health grace until first scrape)
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._scrape_worker, daemon=True, name="engine-stats-scraper"
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------ scrape loop
+    def _scrape_worker(self) -> None:
+        while self._running:
+            try:
+                self._scrape_metrics()
+            except Exception:  # noqa: BLE001 — scraper must survive
+                logger.exception("Engine stats scrape pass failed")
+            self._last_scrape = time.time()
+            time.sleep(self.scrape_interval)
+
+    def _scrape_metrics(self) -> None:
+        import requests
+
+        try:
+            endpoints = get_service_discovery().get_endpoint_info()
+        except AssertionError:
+            return
+        fresh: Dict[str, EngineStats] = {}
+        for ep in endpoints:
+            stats = self._scrape_one_endpoint(requests, ep.url)
+            if stats is not None:
+                fresh[ep.url] = stats
+        with self._lock:
+            self.engine_stats = fresh
+
+    def _scrape_one_endpoint(self, requests_mod, url: str) -> Optional[EngineStats]:
+        try:
+            resp = requests_mod.get(f"{url}/metrics", timeout=5)
+            resp.raise_for_status()
+        except Exception as e:  # noqa: BLE001 — engine may be down
+            logger.warning("Failed to scrape %s/metrics: %s", url, e)
+            return None
+        stats, counters = EngineStats.from_prometheus_text(
+            resp.text, self._prev_counters.get(url)
+        )
+        self._prev_counters[url] = counters
+        return stats
+
+    # -------------------------------------------------------------- interface
+    def get_engine_stats(self) -> Dict[str, EngineStats]:
+        with self._lock:
+            return dict(self.engine_stats)
+
+    def get_health(self) -> bool:
+        return (
+            self._thread.is_alive()
+            and time.time() - self._last_scrape < 4 * self.scrape_interval + 10
+        )
+
+    def close(self) -> None:
+        self._running = False
+
+
+def initialize_engine_stats_scraper(scrape_interval: float = 10.0) -> EngineStatsScraper:
+    return EngineStatsScraper(scrape_interval)
+
+
+def get_engine_stats_scraper() -> EngineStatsScraper:
+    return EngineStatsScraper()

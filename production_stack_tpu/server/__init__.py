@@ -1,0 +1,6 @@
+"""OpenAI-compatible HTTP front end for the TPU serving engine.
+
+The per-pod API tier the reference gets from external vLLM images
+(reference helm/templates/deployment-vllm-multi.yaml:58-134): OpenAI
+endpoints + /health + vllm-compatible /metrics for the router's scraper.
+"""

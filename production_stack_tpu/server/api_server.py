@@ -1,0 +1,328 @@
+"""OpenAI-compatible API server over the TPU ServingEngine.
+
+Endpoints (the surface the router proxies to and the reference's benchmark
+harness drives, reference benchmarks/multi-round-qa/multi-round-qa.py):
+  * POST /v1/chat/completions — streaming (SSE) + non-streaming
+  * POST /v1/completions — streaming + non-streaming
+  * GET  /v1/models, /health, /metrics, /version
+
+Run: ``python -m production_stack_tpu.server.api_server --model tiny-llama``.
+"""
+
+import argparse
+import asyncio
+import json
+import time
+from typing import Optional
+
+from aiohttp import web
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.engine import ServingEngine
+from production_stack_tpu.engine.sampling import SamplingParams
+from production_stack_tpu.protocols import (
+    CompletionUsage,
+    ErrorResponse,
+    ModelCard,
+    ModelList,
+    random_uuid,
+)
+from production_stack_tpu.server.metrics import render_engine_metrics
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+VERSION = "0.1.0"
+
+
+def _error(status: int, message: str, etype: str = "invalid_request_error"):
+    return web.json_response(
+        ErrorResponse(message=message, type=etype, code=status).to_dict(),
+        status=status,
+    )
+
+
+def _sse(obj: dict) -> bytes:
+    return f"data: {json.dumps(obj)}\n\n".encode()
+
+
+class APIServer:
+    def __init__(self, engine: ServingEngine):
+        self.engine = engine
+        self.model_name = engine.config.model_name
+
+    # ----------------------------------------------------------------- routes
+    def build_app(self) -> web.Application:
+        app = web.Application(client_max_size=64 * 1024 * 1024)
+
+        async def on_startup(app):
+            await self.engine.start()
+
+        async def on_cleanup(app):
+            await self.engine.stop()
+
+        app.on_startup.append(on_startup)
+        app.on_cleanup.append(on_cleanup)
+        app.router.add_post("/v1/chat/completions", self.chat_completions)
+        app.router.add_post("/v1/completions", self.completions)
+        app.router.add_get("/v1/models", self.models)
+        app.router.add_get("/health", self.health)
+        app.router.add_get("/metrics", self.metrics)
+        app.router.add_get("/version", self.version)
+        return app
+
+    async def models(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            ModelList(data=[ModelCard(id=self.model_name)]).to_dict()
+        )
+
+    async def health(self, request: web.Request) -> web.Response:
+        if self.engine.is_healthy:
+            return web.json_response({"status": "healthy"})
+        return web.json_response({"status": "unhealthy"}, status=503)
+
+    async def metrics(self, request: web.Request) -> web.Response:
+        return web.Response(
+            text=render_engine_metrics(self.engine, self.model_name),
+            content_type="text/plain",
+        )
+
+    async def version(self, request: web.Request) -> web.Response:
+        return web.json_response({"version": VERSION})
+
+    # ------------------------------------------------------------ completions
+    async def chat_completions(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = json.loads(await request.read())
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return _error(400, "Request body is not valid JSON")
+        messages = body.get("messages")
+        if not messages:
+            return _error(400, "'messages' is required")
+        model = body.get("model", self.model_name)
+        if model != self.model_name:
+            return _error(404, f"Model '{model}' not found",
+                          etype="model_not_found")
+        try:
+            prompt = self.engine.tokenizer.apply_chat_template(
+                messages, add_generation_prompt=True
+            )
+        except Exception as e:  # noqa: BLE001 — malformed messages
+            return _error(400, f"Could not apply chat template: {e}")
+        sampling = SamplingParams.from_request(body, default_max_tokens=256)
+        return await self._generate_response(
+            request, body, prompt, sampling, chat=True
+        )
+
+    async def completions(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = json.loads(await request.read())
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return _error(400, "Request body is not valid JSON")
+        prompt = body.get("prompt")
+        if prompt is None:
+            return _error(400, "'prompt' is required")
+        if isinstance(prompt, list):
+            if not prompt:
+                return _error(400, "'prompt' must not be empty")
+            prompt = prompt[0]  # multi-prompt: phase 2
+        model = body.get("model", self.model_name)
+        if model != self.model_name:
+            return _error(404, f"Model '{model}' not found",
+                          etype="model_not_found")
+        sampling = SamplingParams.from_request(body, default_max_tokens=16)
+        return await self._generate_response(
+            request, body, prompt, sampling, chat=False
+        )
+
+    async def _generate_response(
+        self, request: web.Request, body: dict, prompt: str,
+        sampling: SamplingParams, chat: bool,
+    ) -> web.StreamResponse:
+        request_id = random_uuid("chatcmpl-" if chat else "cmpl-")
+        created = int(time.time())
+        stream = bool(body.get("stream", False))
+        object_name = (
+            "chat.completion.chunk" if chat and stream
+            else "chat.completion" if chat
+            else "text_completion"
+        )
+
+        if stream:
+            # Fail BEFORE the 200 SSE headers when the request is statically
+            # invalid (e.g. prompt exceeds max_model_len): probe by encoding.
+            try:
+                n_prompt = len(self.engine.tokenizer.encode(prompt))
+                if n_prompt >= self.engine.config.max_model_len:
+                    return _error(
+                        400,
+                        f"Prompt of {n_prompt} tokens exceeds max_model_len "
+                        f"{self.engine.config.max_model_len}",
+                    )
+            except Exception:  # noqa: BLE001 — engine will re-raise if real
+                pass
+            response = web.StreamResponse(
+                status=200,
+                headers={"Content-Type": "text/event-stream",
+                         "Cache-Control": "no-cache",
+                         "x-request-id": request_id},
+            )
+            await response.prepare(request)
+            first = True
+            final = None
+            try:
+                async for out in self.engine.generate(
+                    prompt=prompt, sampling=sampling, request_id=request_id
+                ):
+                    final = out
+                    if chat:
+                        delta = {}
+                        if first and (out.text_delta or not out.finished):
+                            delta["role"] = "assistant"
+                            first = False
+                        if out.text_delta:
+                            delta["content"] = out.text_delta
+                        chunk = {
+                            "id": request_id, "object": object_name,
+                            "created": created, "model": self.model_name,
+                            "choices": [{
+                                "index": 0, "delta": delta,
+                                "finish_reason": out.finish_reason,
+                            }],
+                        }
+                    else:
+                        chunk = {
+                            "id": request_id, "object": object_name,
+                            "created": created, "model": self.model_name,
+                            "choices": [{
+                                "index": 0, "text": out.text_delta,
+                                "finish_reason": out.finish_reason,
+                            }],
+                        }
+                    if out.text_delta or out.finished:
+                        await response.write(_sse(chunk))
+                if final is not None and body.get("stream_options", {}).get(
+                    "include_usage"
+                ):
+                    await response.write(_sse({
+                        "id": request_id, "object": object_name,
+                        "created": created, "model": self.model_name,
+                        "choices": [],
+                        "usage": self._usage(final).to_dict(),
+                    }))
+                await response.write(b"data: [DONE]\n\n")
+            except (ConnectionResetError, asyncio.CancelledError):
+                self.engine.abort(request_id)
+                raise
+            except Exception as e:  # noqa: BLE001 — post-headers failure
+                # Headers already sent: emit an SSE error event instead of
+                # letting a bare 200 die silently; free the engine slot.
+                self.engine.abort(request_id)
+                logger.exception("Streaming generation failed")
+                try:
+                    await response.write(_sse({"error": {
+                        "message": str(e), "type": "internal_error",
+                    }}))
+                    await response.write(b"data: [DONE]\n\n")
+                except ConnectionResetError:
+                    pass
+            await response.write_eof()
+            return response
+
+        # Non-streaming
+        text, final = "", None
+        try:
+            async for out in self.engine.generate(
+                prompt=prompt, sampling=sampling, request_id=request_id
+            ):
+                text += out.text_delta
+                final = out
+        except ValueError as e:
+            return _error(400, str(e))
+        assert final is not None
+        if chat:
+            choice = {
+                "index": 0,
+                "message": {"role": "assistant", "content": text},
+                "finish_reason": final.finish_reason,
+            }
+        else:
+            choice = {
+                "index": 0, "text": text,
+                "finish_reason": final.finish_reason,
+            }
+        return web.json_response({
+            "id": request_id,
+            "object": object_name,
+            "created": created,
+            "model": self.model_name,
+            "choices": [choice],
+            "usage": self._usage(final).to_dict(),
+        })
+
+    @staticmethod
+    def _usage(out) -> CompletionUsage:
+        return CompletionUsage(
+            prompt_tokens=out.num_prompt_tokens,
+            completion_tokens=out.num_output_tokens,
+            total_tokens=out.num_prompt_tokens + out.num_output_tokens,
+        )
+
+
+def build_engine_from_args(args: argparse.Namespace) -> ServingEngine:
+    cfg = EngineConfig(
+        model=args.model,
+        served_model_name=args.served_model_name,
+        dtype=args.dtype,
+        max_model_len=args.max_model_len,
+        block_size=args.block_size,
+        num_kv_blocks=args.num_kv_blocks,
+        hbm_utilization=args.gpu_memory_utilization,
+        enable_prefix_caching=not args.no_enable_prefix_caching,
+        max_num_seqs=args.max_num_seqs,
+        max_num_batched_tokens=args.max_num_batched_tokens,
+        tensor_parallel_size=args.tensor_parallel_size,
+        sequence_parallel_size=args.sequence_parallel_size,
+        data_parallel_size=args.data_parallel_size,
+        num_decode_steps=args.num_decode_steps,
+        attn_impl=args.attn_impl,
+    )
+    return ServingEngine(cfg)
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(description="TPU serving engine (OpenAI API)")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--model", required=True)
+    p.add_argument("--served-model-name", default=None)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--max-model-len", type=int, default=2048)
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--num-kv-blocks", type=int, default=None)
+    # flag name kept vllm-compatible (reference chart renders it):
+    p.add_argument("--gpu-memory-utilization", type=float, default=0.9)
+    p.add_argument("--no-enable-prefix-caching", action="store_true")
+    p.add_argument("--max-num-seqs", type=int, default=64)
+    p.add_argument("--max-num-batched-tokens", type=int, default=1024)
+    p.add_argument("--tensor-parallel-size", type=int, default=1)
+    p.add_argument("--sequence-parallel-size", type=int, default=1)
+    p.add_argument("--data-parallel-size", type=int, default=1)
+    p.add_argument("--num-decode-steps", type=int, default=8)
+    p.add_argument("--attn-impl", default="auto",
+                   choices=["auto", "xla", "pallas"])
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
+    engine = build_engine_from_args(args)
+    server = APIServer(engine)
+    app = server.build_app()
+    logger.info("Engine API server on %s:%d (model=%s)",
+                args.host, args.port, server.model_name)
+    web.run_app(app, host=args.host, port=args.port, print=None)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""Engine-pod /metrics exposition, vllm-series-compatible.
+
+Emits exactly the series the router's EngineStatsScraper parses
+(reference src/vllm_router/stats/engine_stats.py:128-155 is the contract):
+vllm:num_requests_running, vllm:num_requests_waiting,
+vllm:gpu_prefix_cache_hits_total, vllm:gpu_prefix_cache_queries_total,
+vllm:gpu_cache_usage_perc (TPU HBM KV-pool usage), vllm:num_preemptions_total,
+plus token throughput counters for dashboards.
+"""
+
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from production_stack_tpu.engine.engine import ServingEngine
+
+
+def render_engine_metrics(engine: "ServingEngine", model_name: str) -> str:
+    s = engine.stats()
+    label = f'{{model_name="{model_name}"}}'
+    lines = [
+        "# HELP vllm:num_requests_running Running requests",
+        "# TYPE vllm:num_requests_running gauge",
+        f"vllm:num_requests_running{label} {s['num_requests_running']}",
+        "# HELP vllm:num_requests_waiting Waiting requests",
+        "# TYPE vllm:num_requests_waiting gauge",
+        f"vllm:num_requests_waiting{label} {s['num_requests_waiting']}",
+        "# HELP vllm:gpu_cache_usage_perc KV-pool usage (TPU HBM)",
+        "# TYPE vllm:gpu_cache_usage_perc gauge",
+        f"vllm:gpu_cache_usage_perc{label} {s['kv_cache_usage']:.6f}",
+        "# HELP vllm:gpu_prefix_cache_hits_total Prefix cache hit tokens",
+        "# TYPE vllm:gpu_prefix_cache_hits_total counter",
+        f"vllm:gpu_prefix_cache_hits_total{label} {s['prefix_cache_hits']}",
+        "# HELP vllm:gpu_prefix_cache_queries_total Prefix cache query tokens",
+        "# TYPE vllm:gpu_prefix_cache_queries_total counter",
+        f"vllm:gpu_prefix_cache_queries_total{label} {s['prefix_cache_queries']}",
+        "# HELP vllm:num_preemptions_total Preempted sequences",
+        "# TYPE vllm:num_preemptions_total counter",
+        f"vllm:num_preemptions_total{label} {s['num_preemptions']}",
+        "# HELP vllm:prompt_tokens_total Prefilled tokens",
+        "# TYPE vllm:prompt_tokens_total counter",
+        f"vllm:prompt_tokens_total{label} {s['prompt_tokens_total']}",
+        "# HELP vllm:generation_tokens_total Generated tokens",
+        "# TYPE vllm:generation_tokens_total counter",
+        f"vllm:generation_tokens_total{label} {s['generation_tokens_total']}",
+    ]
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,122 @@
+"""Protocol-faithful fake OpenAI engine for router tests.
+
+The reference's load-bearing test asset (reference
+src/tests/perftest/fake-openai-server.py): a mock vLLM-protocol server that
+streams "Hello " at a configured speed with a configured TTFT, and exposes
+/metrics in vllm exposition format so the scraper, routing logic, and
+dashboards are all testable without TPUs.
+"""
+
+import asyncio
+import json
+import time
+
+from aiohttp import web
+
+
+class FakeEngine:
+    def __init__(self, model: str = "fake-model", speed: float = 500.0,
+                 ttft: float = 0.0, max_tokens_default: int = 16):
+        self.model = model
+        self.speed = speed          # tokens/sec
+        self.ttft = ttft
+        self.max_tokens_default = max_tokens_default
+        self.running = 0
+        self.waiting = 0
+        self.prefix_hits = 0
+        self.prefix_queries = 0
+        self.kv_usage = 0.0
+        self.requests_seen = []     # (endpoint, body) tuples for assertions
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/v1/chat/completions", self.chat)
+        app.router.add_post("/v1/completions", self.completions)
+        app.router.add_get("/v1/models", self.models)
+        app.router.add_get("/health", self.health)
+        app.router.add_get("/metrics", self.metrics)
+        return app
+
+    async def models(self, request):
+        return web.json_response({
+            "object": "list",
+            "data": [{"id": self.model, "object": "model", "created": 0,
+                      "owned_by": "fake"}],
+        })
+
+    async def health(self, request):
+        return web.json_response({"status": "healthy"})
+
+    async def metrics(self, request):
+        text = (
+            f'vllm:num_requests_running{{model_name="{self.model}"}} {self.running}\n'
+            f'vllm:num_requests_waiting{{model_name="{self.model}"}} {self.waiting}\n'
+            f'vllm:gpu_prefix_cache_hits_total{{model_name="{self.model}"}} {self.prefix_hits}\n'
+            f'vllm:gpu_prefix_cache_queries_total{{model_name="{self.model}"}} {self.prefix_queries}\n'
+            f'vllm:gpu_cache_usage_perc{{model_name="{self.model}"}} {self.kv_usage}\n'
+        )
+        return web.Response(text=text, content_type="text/plain")
+
+    async def chat(self, request):
+        return await self._complete(request, chat=True)
+
+    async def completions(self, request):
+        return await self._complete(request, chat=False)
+
+    async def _complete(self, request, chat: bool):
+        body = json.loads(await request.read())
+        self.requests_seen.append(
+            ("/v1/chat/completions" if chat else "/v1/completions", body)
+        )
+        n = int(body.get("max_tokens") or self.max_tokens_default)
+        stream = bool(body.get("stream", False))
+        self.running += 1
+        try:
+            if self.ttft:
+                await asyncio.sleep(self.ttft)
+            if not stream:
+                text = "Hello " * n
+                if self.speed:
+                    await asyncio.sleep(n / self.speed)
+                payload = {
+                    "id": "fake-cmpl", "created": int(time.time()),
+                    "model": self.model,
+                    "object": "chat.completion" if chat else "text_completion",
+                    "choices": [{
+                        "index": 0,
+                        **({"message": {"role": "assistant", "content": text}}
+                           if chat else {"text": text}),
+                        "finish_reason": "length",
+                    }],
+                    "usage": {"prompt_tokens": 5, "completion_tokens": n,
+                              "total_tokens": 5 + n},
+                }
+                return web.json_response(payload)
+
+            resp = web.StreamResponse(
+                status=200, headers={"Content-Type": "text/event-stream"}
+            )
+            await resp.prepare(request)
+            for i in range(n):
+                chunk = {
+                    "id": "fake-cmpl", "created": int(time.time()),
+                    "model": self.model,
+                    "object": ("chat.completion.chunk" if chat
+                               else "text_completion"),
+                    "choices": [{
+                        "index": 0,
+                        **({"delta": {"content": "Hello "}} if chat
+                           else {"text": "Hello "}),
+                        "finish_reason": (
+                            "length" if i == n - 1 else None
+                        ),
+                    }],
+                }
+                await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+                if self.speed:
+                    await asyncio.sleep(1.0 / self.speed)
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+            return resp
+        finally:
+            self.running -= 1

@@ -1,0 +1,126 @@
+"""Engine API server e2e on a tiny model: OpenAI surface over the real
+ServingEngine (the tier the reference outsources to vLLM images)."""
+
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.engine import ServingEngine
+from production_stack_tpu.server.api_server import APIServer
+
+
+@pytest.fixture()
+def engine_cfg():
+    return EngineConfig(
+        model="tiny-llama", max_model_len=256, block_size=4,
+        num_kv_blocks=128, max_num_seqs=8, max_num_batched_tokens=32,
+        attn_impl="xla",
+    )
+
+
+async def _client(cfg):
+    server = APIServer(ServingEngine(cfg))
+    client = TestClient(TestServer(server.build_app()))
+    await client.start_server()
+    return client
+
+
+async def test_openai_surface(engine_cfg):
+    client = await _client(engine_cfg)
+    try:
+        resp = await client.get("/v1/models")
+        assert (await resp.json())["data"][0]["id"] == "tiny-llama"
+
+        resp = await client.get("/health")
+        assert resp.status == 200
+
+        resp = await client.get("/version")
+        assert resp.status == 200
+
+        # Non-streaming chat completion
+        resp = await client.post("/v1/chat/completions", json={
+            "model": "tiny-llama",
+            "messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 4, "temperature": 0, "ignore_eos": True,
+        })
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["object"] == "chat.completion"
+        assert body["usage"]["completion_tokens"] == 4
+        assert body["choices"][0]["finish_reason"] == "length"
+
+        # Non-streaming text completion
+        resp = await client.post("/v1/completions", json={
+            "model": "tiny-llama", "prompt": "abc", "max_tokens": 3,
+            "temperature": 0, "ignore_eos": True,
+        })
+        body = await resp.json()
+        assert body["object"] == "text_completion"
+        assert body["usage"]["completion_tokens"] == 3
+
+        # /metrics exposes the scraper contract series
+        resp = await client.get("/metrics")
+        text = await resp.text()
+        for series in ("vllm:num_requests_running",
+                       "vllm:num_requests_waiting",
+                       "vllm:gpu_cache_usage_perc",
+                       "vllm:gpu_prefix_cache_hits_total",
+                       "vllm:gpu_prefix_cache_queries_total"):
+            assert series in text, series
+    finally:
+        await client.close()
+
+
+async def test_streaming_chat(engine_cfg):
+    client = await _client(engine_cfg)
+    try:
+        resp = await client.post("/v1/chat/completions", json={
+            "model": "tiny-llama",
+            "messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 6, "temperature": 0, "stream": True,
+            "ignore_eos": True,
+            "stream_options": {"include_usage": True},
+        })
+        assert resp.status == 200
+        raw = (await resp.content.read()).decode()
+        events = [ln for ln in raw.splitlines() if ln.startswith("data:")]
+        assert events[-1] == "data: [DONE]"
+        chunks = [json.loads(e[5:]) for e in events[:-1]]
+        finish = [c for c in chunks
+                  if c["choices"] and c["choices"][0]["finish_reason"]]
+        assert finish and finish[-1]["choices"][0]["finish_reason"] == "length"
+        usage = [c for c in chunks if c.get("usage")]
+        assert usage and usage[-1]["usage"]["completion_tokens"] == 6
+    finally:
+        await client.close()
+
+
+async def test_errors(engine_cfg):
+    client = await _client(engine_cfg)
+    try:
+        resp = await client.post("/v1/chat/completions", json={})
+        assert resp.status == 400
+        resp = await client.post("/v1/chat/completions", json={
+            "model": "wrong", "messages": [{"role": "user", "content": "x"}],
+        })
+        assert resp.status == 404
+        resp = await client.post(
+            "/v1/completions", data=b"not json",
+            headers={"Content-Type": "application/json"},
+        )
+        assert resp.status == 400
+        # Oversized prompt -> clean 400, not a hang
+        resp = await client.post("/v1/completions", json={
+            "model": "tiny-llama", "prompt": "x" * 5000, "max_tokens": 2,
+        })
+        assert resp.status == 400
+        # Streaming oversized prompt must also 400 BEFORE the SSE headers.
+        resp = await client.post("/v1/completions", json={
+            "model": "tiny-llama", "prompt": "x" * 5000, "max_tokens": 2,
+            "stream": True,
+        })
+        assert resp.status == 400
+    finally:
+        await client.close()

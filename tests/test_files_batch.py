@@ -1,0 +1,108 @@
+"""Files + Batch API tests through the router (reference
+src/tests/test_file_storage.py + batch service; the reference's batch
+processor never executed requests — ours does, against a fake engine)."""
+
+import asyncio
+import json
+
+from aiohttp import FormData
+from aiohttp.test_utils import TestClient, TestServer
+
+from tests.fake_engine import FakeEngine
+from tests.test_router_e2e import router_args
+
+
+async def _stack(tmp_path):
+    from production_stack_tpu.router.app import build_app
+
+    eng = FakeEngine(model="m1", speed=0.0)
+    srv = TestServer(eng.build_app())
+    await srv.start_server()
+    url = f"http://127.0.0.1:{srv.port}"
+    args = router_args(
+        [url], ["m1"], enable_batch_api=True,
+        file_storage_path=str(tmp_path / "files"),
+    )
+    app = build_app(args)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return eng, srv, client
+
+
+async def test_file_crud(tmp_path):
+    eng, srv, client = await _stack(tmp_path)
+    try:
+        form = FormData()
+        form.add_field("file", b"line1\nline2", filename="input.jsonl")
+        form.add_field("purpose", "batch")
+        resp = await client.post("/v1/files", data=form)
+        assert resp.status == 200
+        info = await resp.json()
+        assert info["object"] == "file"
+        assert info["bytes"] == 11
+
+        resp = await client.get(f"/v1/files/{info['id']}")
+        assert (await resp.json())["filename"] == "input.jsonl"
+
+        resp = await client.get(f"/v1/files/{info['id']}/content")
+        assert await resp.read() == b"line1\nline2"
+
+        resp = await client.get("/v1/files/file-missing")
+        assert resp.status == 404
+    finally:
+        await client.close()
+        await srv.close()
+
+
+async def test_batch_executes_requests(tmp_path):
+    eng, srv, client = await _stack(tmp_path)
+    try:
+        lines = [
+            json.dumps({
+                "custom_id": f"req-{i}",
+                "method": "POST",
+                "url": "/v1/chat/completions",
+                "body": {"model": "m1",
+                         "messages": [{"role": "user", "content": "hi"}],
+                         "max_tokens": 2},
+            })
+            for i in range(3)
+        ]
+        form = FormData()
+        form.add_field("file", "\n".join(lines).encode(),
+                       filename="batch.jsonl")
+        form.add_field("purpose", "batch")
+        upload = await (await client.post("/v1/files", data=form)).json()
+
+        resp = await client.post("/v1/batches", json={
+            "input_file_id": upload["id"],
+            "endpoint": "/v1/chat/completions",
+        })
+        assert resp.status == 200
+        batch = await resp.json()
+        assert batch["status"] == "validating"
+
+        for _ in range(40):  # poll until the background processor finishes
+            await asyncio.sleep(0.25)
+            batch = await (
+                await client.get(f"/v1/batches/{batch['id']}")
+            ).json()
+            if batch["status"] == "completed":
+                break
+        assert batch["status"] == "completed", batch
+        assert batch["request_counts"]["completed"] == 3
+        assert len(eng.requests_seen) == 3
+
+        out = await (
+            await client.get(f"/v1/files/{batch['output_file_id']}/content")
+        ).read()
+        results = [json.loads(ln) for ln in out.decode().splitlines()]
+        assert len(results) == 3
+        assert all(r["response"]["status_code"] == 200 for r in results)
+        assert {r["custom_id"] for r in results} == {"req-0", "req-1", "req-2"}
+
+        resp = await client.get("/v1/batches")
+        assert len((await resp.json())["data"]) == 1
+    finally:
+        await client.close()
+        await srv.close()

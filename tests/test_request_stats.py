@@ -1,0 +1,77 @@
+"""RequestStatsMonitor + EngineStats parsing unit tests."""
+
+from production_stack_tpu.router.stats.engine_stats import EngineStats
+from production_stack_tpu.router.stats.request_stats import (
+    MovingAverageMonitor,
+    RequestStatsMonitor,
+)
+
+
+def test_moving_average_window_expiry():
+    m = MovingAverageMonitor(window_size=10.0)
+    m.update(0.0, 1.0)
+    m.update(5.0, 3.0)
+    assert m.get_average() == 2.0
+    m.update(11.0, 5.0)  # t=0 sample expires
+    assert m.get_count() == 2
+    assert m.get_average() == 4.0
+
+
+def test_request_lifecycle_stats():
+    mon = RequestStatsMonitor(sliding_window_size=60.0)
+    url = "http://engine"
+    mon.on_new_request(url, "r1", 100.0)
+    stats = mon.get_request_stats(100.5)
+    assert stats[url].in_prefill_requests == 1
+    assert stats[url].in_decoding_requests == 0
+
+    mon.on_request_response(url, "r1", 100.8)   # first token: TTFT=0.8
+    stats = mon.get_request_stats(101.0)
+    assert stats[url].in_prefill_requests == 0
+    assert stats[url].in_decoding_requests == 1
+    assert abs(stats[url].ttft - 0.8) < 1e-9
+
+    mon.on_request_token(url, "r1", 100.9)
+    mon.on_request_complete(url, "r1", 101.0)
+    stats = mon.get_request_stats(101.5)
+    assert stats[url].finished_requests == 1
+    assert stats[url].in_decoding_requests == 0
+    assert abs(stats[url].avg_latency - 1.0) < 1e-9
+    assert stats[url].qps > 0
+
+
+def test_swapped_counter():
+    mon = RequestStatsMonitor(sliding_window_size=60.0)
+    mon.on_request_swapped("http://e", "r9", 1.0)
+    assert mon.get_request_stats(2.0)["http://e"].num_swapped_requests == 1
+
+
+def test_engine_stats_interval_hit_rate():
+    text1 = (
+        "vllm:num_requests_running 3\n"
+        "vllm:num_requests_waiting 1\n"
+        "vllm:gpu_prefix_cache_hits_total 100\n"
+        "vllm:gpu_prefix_cache_queries_total 200\n"
+        "vllm:gpu_cache_usage_perc 0.5\n"
+    )
+    stats1, counters1 = EngineStats.from_prometheus_text(text1)
+    assert stats1.num_running_requests == 3
+    assert stats1.num_queuing_requests == 1
+    assert stats1.gpu_prefix_cache_hit_rate == 0.5  # lifetime on first scrape
+    assert stats1.gpu_cache_usage_perc == 0.5
+
+    # Second scrape: 80 new hits out of 100 new queries -> 0.8 interval rate,
+    # NOT the lifetime 180/300 (the fork's delta contract,
+    # reference engine_stats.py:141-155).
+    text2 = (
+        "vllm:gpu_prefix_cache_hits_total 180\n"
+        "vllm:gpu_prefix_cache_queries_total 300\n"
+    )
+    stats2, _ = EngineStats.from_prometheus_text(text2, counters1)
+    assert abs(stats2.gpu_prefix_cache_hit_rate - 0.8) < 1e-9
+
+
+def test_engine_stats_labels_parsed():
+    text = 'vllm:num_requests_running{model_name="m"} 7\n'
+    stats, _ = EngineStats.from_prometheus_text(text)
+    assert stats.num_running_requests == 7

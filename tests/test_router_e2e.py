@@ -1,0 +1,195 @@
+"""Router e2e: the real router app proxying to fake engines.
+
+Mirrors the reference's CI shape (reference
+.github/workflows/router-e2e-test.yml: fake servers -> router -> load) using
+in-process aiohttp TestServers.
+"""
+
+import argparse
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from tests.fake_engine import FakeEngine
+
+
+def router_args(backends, models, routing="roundrobin", **overrides):
+    base = dict(
+        host="127.0.0.1", port=0,
+        service_discovery="static",
+        static_backends=",".join(backends),
+        static_models=",".join(models),
+        k8s_namespace="default", k8s_port=8000, k8s_label_selector=None,
+        routing_logic=routing, session_key=None, block_reuse_timeout=300.0,
+        engine_stats_interval=1.0, request_stats_window=60.0,
+        log_stats=False, log_stats_interval=10.0,
+        dynamic_config_json=None, feature_gates="",
+        enable_batch_api=False, file_storage_class="local_file",
+        file_storage_path=None, batch_processor="local",
+        request_rewriter="noop", callbacks="",
+    )
+    base.update(overrides)
+    return argparse.Namespace(**base)
+
+
+async def _start_stack(n_engines=2, routing="roundrobin", models=None,
+                       **overrides):
+    from production_stack_tpu.router.app import build_app
+
+    engines, servers = [], []
+    for i in range(n_engines):
+        model = (models[i] if models else "m1")
+        eng = FakeEngine(model=model, speed=2000.0)
+        srv = TestServer(eng.build_app())
+        await srv.start_server()
+        engines.append(eng)
+        servers.append(srv)
+    urls = [f"http://127.0.0.1:{s.port}" for s in servers]
+    mods = models or ["m1"] * n_engines
+    args = router_args(urls, mods, routing=routing, **overrides)
+    app = build_app(args)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return engines, servers, urls, client
+
+
+async def _stop_stack(servers, client):
+    await client.close()
+    for s in servers:
+        await s.close()
+
+
+async def test_models_union_and_roundrobin_proxy():
+    engines, servers, urls, client = await _start_stack(n_engines=2)
+    try:
+        resp = await client.get("/v1/models")
+        assert resp.status == 200
+        data = await resp.json()
+        assert [m["id"] for m in data["data"]] == ["m1"]
+
+        for _ in range(4):
+            resp = await client.post("/v1/chat/completions", json={
+                "model": "m1",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 3,
+            })
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["choices"][0]["message"]["content"].startswith("Hello")
+        # Round-robin spread both backends evenly.
+        assert len(engines[0].requests_seen) == 2
+        assert len(engines[1].requests_seen) == 2
+    finally:
+        await _stop_stack(servers, client)
+
+
+async def test_streaming_relay():
+    engines, servers, urls, client = await _start_stack(n_engines=1)
+    try:
+        resp = await client.post("/v1/chat/completions", json={
+            "model": "m1",
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 5, "stream": True,
+        })
+        assert resp.status == 200
+        raw = await resp.content.read()
+        events = [ln for ln in raw.decode().splitlines() if ln.startswith("data:")]
+        assert events[-1] == "data: [DONE]"
+        chunks = [json.loads(e[5:]) for e in events[:-1]]
+        text = "".join(
+            c["choices"][0]["delta"].get("content", "") for c in chunks
+        )
+        assert text == "Hello " * 5
+    finally:
+        await _stop_stack(servers, client)
+
+
+async def test_model_filtering_routes_by_model():
+    engines, servers, urls, client = await _start_stack(
+        n_engines=2, models=["m1", "m2"]
+    )
+    try:
+        for model, eng in (("m1", engines[0]), ("m2", engines[1])):
+            resp = await client.post("/v1/completions", json={
+                "model": model, "prompt": "x", "max_tokens": 2,
+            })
+            assert resp.status == 200
+            assert len(eng.requests_seen) == 1
+
+        resp = await client.post("/v1/completions", json={
+            "model": "missing", "prompt": "x",
+        })
+        assert resp.status == 404
+    finally:
+        await _stop_stack(servers, client)
+
+
+async def test_session_affinity_e2e():
+    engines, servers, urls, client = await _start_stack(
+        n_engines=3, routing="session", session_key="x-user-id",
+    )
+    try:
+        for _ in range(6):
+            resp = await client.post(
+                "/v1/chat/completions",
+                json={"model": "m1",
+                      "messages": [{"role": "user", "content": "hi"}],
+                      "max_tokens": 2},
+                headers={"x-user-id": "alice"},
+            )
+            assert resp.status == 200
+        counts = sorted(len(e.requests_seen) for e in engines)
+        assert counts == [0, 0, 6]  # all requests pinned to one engine
+    finally:
+        await _stop_stack(servers, client)
+
+
+async def test_health_and_metrics_endpoints():
+    engines, servers, urls, client = await _start_stack(n_engines=2)
+    try:
+        engines[0].prefix_hits = 50
+        engines[0].prefix_queries = 100
+        resp = await client.get("/health")
+        assert resp.status == 200
+        assert (await resp.json())["status"] == "healthy"
+
+        await client.post("/v1/completions", json={
+            "model": "m1", "prompt": "x", "max_tokens": 2,
+        })
+        # Wait for a scrape pass (interval=1s).
+        await asyncio.sleep(1.5)
+        resp = await client.get("/metrics")
+        assert resp.status == 200
+        text = await resp.text()
+        assert "vllm:current_qps" in text
+        assert "vllm:healthy_pods_total" in text
+        assert 'vllm:gpu_prefix_cache_hit_rate' in text
+    finally:
+        await _stop_stack(servers, client)
+
+
+async def test_error_on_missing_model_field():
+    engines, servers, urls, client = await _start_stack(n_engines=1)
+    try:
+        resp = await client.post("/v1/chat/completions", json={
+            "messages": [{"role": "user", "content": "hi"}],
+        })
+        assert resp.status == 400
+        body = await resp.json()
+        assert "model" in body["error"]["message"]
+    finally:
+        await _stop_stack(servers, client)
+
+
+async def test_backend_down_returns_502():
+    engines, servers, urls, client = await _start_stack(n_engines=1)
+    try:
+        await servers[0].close()  # kill the only backend
+        resp = await client.post("/v1/completions", json={
+            "model": "m1", "prompt": "x",
+        })
+        assert resp.status == 502
+    finally:
+        await _stop_stack(servers, client)

@@ -1,0 +1,115 @@
+"""Routing-logic unit tests with hand-rolled fakes (reference test strategy:
+src/tests/test_session_router.py uses local stubs, no network)."""
+
+import pytest
+
+from production_stack_tpu.router.routing_logic import (
+    CacheAwareLoadBalancingRouter,
+    RoundRobinRouter,
+    SessionRouter,
+    initialize_routing_logic,
+    reconfigure_routing_logic,
+)
+from production_stack_tpu.router.service_discovery import EndpointInfo
+from production_stack_tpu.router.stats.engine_stats import EngineStats
+from production_stack_tpu.router.stats.request_stats import RequestStats
+
+
+class FakeRequest:
+    def __init__(self, headers=None, json_body=None):
+        self.headers = headers or {}
+        self.json_body = json_body or {}
+
+
+def eps(*urls):
+    return [EndpointInfo(url=u, model_names=["m"]) for u in urls]
+
+
+def test_roundrobin_cycles():
+    r = RoundRobinRouter()
+    urls = [
+        r.route_request(eps("http://a", "http://b", "http://c"), {}, {},
+                        FakeRequest())
+        for _ in range(6)
+    ]
+    assert urls == ["http://a", "http://b", "http://c"] * 2
+
+
+def test_session_router_sticky():
+    r = SessionRouter(session_key="x-user-id")
+    e = eps("http://a", "http://b", "http://c")
+    req = FakeRequest(headers={"x-user-id": "alice"})
+    first = r.route_request(e, {}, {}, req)
+    for _ in range(5):
+        assert r.route_request(e, {}, {}, req) == first
+
+
+def test_session_router_minimal_reassignment_on_leave():
+    r = SessionRouter(session_key="x-user-id")
+    e3 = eps("http://a", "http://b", "http://c")
+    users = [f"user{i}" for i in range(200)]
+    before = {
+        u: r.route_request(e3, {}, {}, FakeRequest(headers={"x-user-id": u}))
+        for u in users
+    }
+    survivors = [ep for ep in e3 if ep.url != "http://c"]
+    moved = 0
+    for u in users:
+        after = r.route_request(
+            survivors, {}, {}, FakeRequest(headers={"x-user-id": u})
+        )
+        if before[u] != "http://c" and after != before[u]:
+            moved += 1
+    # Consistent hashing: sessions not on the dead node overwhelmingly stay.
+    assert moved < len(users) * 0.2
+
+
+def test_session_router_qps_fallback_without_key():
+    r = SessionRouter(session_key="x-user-id")
+    e = eps("http://a", "http://b")
+    stats = {"http://a": RequestStats(qps=10.0), "http://b": RequestStats(qps=1.0)}
+    assert r.route_request(e, {}, stats, FakeRequest()) == "http://b"
+
+
+def test_cache_aware_session_affinity():
+    r = CacheAwareLoadBalancingRouter(session_key="x-user-id")
+    e = eps("http://a", "http://b")
+    req = FakeRequest(headers={"x-user-id": "bob"})
+    first = r.route_request(e, {}, {}, req)
+    # Affinity holds on repeat requests (KV blocks predicted resident).
+    for _ in range(5):
+        assert r.route_request(e, {}, {}, req) == first
+
+
+def test_cache_aware_avoids_overloaded_engine():
+    r = CacheAwareLoadBalancingRouter(session_key="x-user-id")
+    e = eps("http://a", "http://b")
+    req = FakeRequest(headers={"x-user-id": "carol"})
+    first = r.route_request(e, {}, {}, req)
+    other = "http://b" if first == "http://a" else "http://a"
+    # Saturate the affine engine far past the cache benefit.
+    stats = {
+        first: EngineStats(num_running_requests=64, num_queuing_requests=32,
+                           gpu_cache_usage_perc=1.0),
+        other: EngineStats(),
+    }
+    assert r.route_request(e, stats, {}, req) == other
+
+
+def test_cache_aware_keyless_goes_least_loaded():
+    r = CacheAwareLoadBalancingRouter(session_key="x-user-id")
+    e = eps("http://a", "http://b")
+    stats = {
+        "http://a": EngineStats(num_running_requests=32),
+        "http://b": EngineStats(num_running_requests=0),
+    }
+    assert r.route_request(e, stats, {}, FakeRequest()) == "http://b"
+
+
+def test_initialize_and_reconfigure_singletons():
+    r1 = initialize_routing_logic("roundrobin")
+    assert initialize_routing_logic("roundrobin") is r1
+    r2 = reconfigure_routing_logic("session", session_key="x-user-id")
+    assert isinstance(r2, SessionRouter)
+    with pytest.raises(ValueError):
+        initialize_routing_logic("bogus")
