@@ -88,6 +88,18 @@ class ModelRunner:
         self.model_config = model_config
         self.mesh = mesh
         self.attn_impl = config.resolved_attn_impl()
+        from production_stack_tpu.parallel.mesh import AXIS_TP
+
+        if self.attn_impl == "pallas" and mesh.shape[AXIS_TP] > 1:
+            # The pallas decode kernel has no GSPMD partitioning rule yet;
+            # under tensor parallelism GSPMD would replicate (all-gather) the
+            # head-sharded KV pools -> instant HBM OOM. The XLA einsum path
+            # propagates the head sharding correctly.
+            logger.warning(
+                "attn_impl=pallas is single-chip only for now; using XLA "
+                "paged attention under tp=%d", mesh.shape[AXIS_TP],
+            )
+            self.attn_impl = "xla"
         self.dtype = _dtype(config.dtype)
         if config.compilation_cache_dir:
             _setup_compilation_cache(config.compilation_cache_dir)
@@ -103,9 +115,11 @@ class ModelRunner:
         self.num_kv_blocks = num_kv_blocks or config.num_kv_blocks or \
             self._derive_num_blocks()
         num_slots = self.num_kv_blocks * config.block_size
+        # Head-major pools: the Pallas decode kernel DMAs [Hkv, bs, Dh] pages
+        # straight into compute layout, no per-page relayout.
         kv_shape = (
-            model_config.num_layers, num_slots,
-            model_config.num_kv_heads, model_config.head_dim_,
+            model_config.num_layers, model_config.num_kv_heads,
+            num_slots, model_config.head_dim_,
         )
         kv_sh = kv_pool_sharding(model_config, mesh)
         self.kv_k = jax.device_put(jnp.zeros(kv_shape, self.dtype), kv_sh)

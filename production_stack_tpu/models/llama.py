@@ -89,7 +89,7 @@ def _layer_body(
     attn_impl: str,
     hidden: jax.Array,        # [B, T, D]
     lp: Dict,                 # one layer's params (leading L axis sliced off)
-    k_pool: jax.Array,        # [num_slots, Hkv, Dh]
+    k_pool: jax.Array,        # [Hkv, num_slots, Dh] (head-major)
     v_pool: jax.Array,
     cos: jax.Array,
     sin: jax.Array,
@@ -132,7 +132,7 @@ def forward(
     cfg: ModelConfig,
     token_ids: jax.Array,     # [B, T]
     positions: jax.Array,     # [B, T]
-    kv_k: jax.Array,          # [L, num_slots, Hkv, Dh]
+    kv_k: jax.Array,          # [L, Hkv, num_slots, Dh] (head-major)
     kv_v: jax.Array,
     slot_mapping: jax.Array,  # [B, T]
     block_tables: jax.Array,  # [B, Mb]

@@ -29,20 +29,21 @@ _NEG_INF = float(jnp.finfo(jnp.float32).min)
 def gather_kv_pages(pool: jax.Array, block_tables: jax.Array, block_size: int) -> jax.Array:
     """Gather per-sequence KV from the slot pool.
 
-    pool: [num_slots, Hkv, Dh]; block_tables: [B, Mb] -> [B, Mb*bs, Hkv, Dh].
+    pool: [Hkv, num_slots, Dh] (head-major so the Pallas kernel DMAs pages
+    with no relayout); block_tables: [B, Mb] -> [Hkv, B, Mb*bs, Dh].
     """
     b, mb = block_tables.shape
     slots = block_tables[:, :, None] * block_size + jnp.arange(
         block_size, dtype=block_tables.dtype
     )[None, None, :]
-    return pool[slots.reshape(b, mb * block_size)]
+    return pool[:, slots.reshape(b, mb * block_size)]
 
 
 @functools.partial(jax.jit, static_argnames=("block_size",))
 def paged_attention_xla(
     q: jax.Array,             # [B, T, H, Dh]
-    k_pool: jax.Array,        # [num_slots, Hkv, Dh]
-    v_pool: jax.Array,        # [num_slots, Hkv, Dh]
+    k_pool: jax.Array,        # [Hkv, num_slots, Dh]
+    v_pool: jax.Array,        # [Hkv, num_slots, Dh]
     block_tables: jax.Array,  # [B, Mb] int32
     kv_lens: jax.Array,       # [B] int32 — total KV length incl. current chunk
     q_positions: jax.Array,   # [B, T] int32 — absolute positions of queries
@@ -56,18 +57,18 @@ def paged_attention_xla(
     sequence; slots beyond kv_len are masked (they may alias the null block).
     """
     b, t, h, dh = q.shape
-    hkv = k_pool.shape[1]
+    hkv = k_pool.shape[0]
     g = h // hkv
     if scale is None:
         scale = dh ** -0.5
 
-    k = gather_kv_pages(k_pool, block_tables, block_size)  # [B, S, Hkv, Dh]
+    k = gather_kv_pages(k_pool, block_tables, block_size)  # [Hkv, B, S, Dh]
     v = gather_kv_pages(v_pool, block_tables, block_size)
-    s = k.shape[1]
+    s = k.shape[2]
 
     qg = q.reshape(b, t, hkv, g, dh).astype(jnp.float32) * scale
     # scores: [B, Hkv, G, T, S]
-    scores = jnp.einsum("btkgd,bskd->bkgts", qg, k.astype(jnp.float32))
+    scores = jnp.einsum("btkgd,kbsd->bkgts", qg, k.astype(jnp.float32))
 
     key_pos = jnp.arange(s, dtype=jnp.int32)[None, :]               # [1, S]
     valid = key_pos < kv_lens[:, None]                               # [B, S]
@@ -76,7 +77,7 @@ def paged_attention_xla(
     scores = jnp.where(mask, scores, _NEG_INF)
 
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bkgts,bskd->btkgd", probs, v.astype(jnp.float32))
+    out = jnp.einsum("bkgts,kbsd->btkgd", probs, v.astype(jnp.float32))
     return out.reshape(b, t, h, dh).astype(q.dtype)
 
 
@@ -107,7 +108,7 @@ def paged_attention(
 
 
 def write_kv_to_pool(
-    k_pool: jax.Array,      # [num_slots, Hkv, Dh]
+    k_pool: jax.Array,      # [Hkv, num_slots, Dh]
     v_pool: jax.Array,
     k_new: jax.Array,       # [B, T, Hkv, Dh]
     v_new: jax.Array,
@@ -119,6 +120,7 @@ def write_kv_to_pool(
     harmlessly in slots that are never unmasked by attention.
     """
     flat = slot_mapping.reshape(-1)
-    kf = k_new.reshape(-1, *k_new.shape[2:]).astype(k_pool.dtype)
-    vf = v_new.reshape(-1, *v_new.shape[2:]).astype(v_pool.dtype)
-    return k_pool.at[flat].set(kf), v_pool.at[flat].set(vf)
+    # [B, T, Hkv, Dh] -> [Hkv, B*T, Dh] to match the head-major pool.
+    kf = k_new.reshape(-1, *k_new.shape[2:]).transpose(1, 0, 2).astype(k_pool.dtype)
+    vf = v_new.reshape(-1, *v_new.shape[2:]).transpose(1, 0, 2).astype(v_pool.dtype)
+    return k_pool.at[:, flat].set(kf), v_pool.at[:, flat].set(vf)

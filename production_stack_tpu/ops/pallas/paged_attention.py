@@ -1,0 +1,250 @@
+"""Pallas TPU flash-decode kernel over the paged KV pool.
+
+The TPU-native replacement for the paged-attention CUDA kernels inside the
+reference's external vLLM images (SURVEY.md §2.2 "vLLM engine"). Design:
+
+  * Grid over sequences. Each program computes the full [H, Dh] attention
+    output for one decode query against that sequence's KV pages.
+  * The KV pools stay in HBM (`pltpu.HBM`); the kernel DMAs pages into VMEM
+    itself. Pages are grouped into SUPERPAGES of 128 tokens: one compute
+    iteration covers 128 keys (an MXU-friendly tile), while the underlying
+    DMAs stay page-granular (pages are scattered in the pool). Two superpage
+    buffers double-buffer fetch against compute.
+  * Block tables + kv lengths ride scalar prefetch (SMEM) so DMA source
+    addresses are computable before the body runs.
+  * Online softmax (flash) accumulation in fp32 across superpages.
+
+Decode-only (T == 1): the query's position is kv_len-1, so causality is
+exactly "attend to slots < kv_len" and no per-token causal mask is needed.
+Prefill chunks use the XLA path (compute-bound there, gather cost amortized).
+
+Constraint: Mosaic requires DMA slice trailing dims aligned to the 128-lane
+tiling, so this kernel serves head_dim % 128 == 0 models (Llama-3, Qwen2
+large, etc.); others fall back to the XLA path automatically.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+SUPER_TOKENS = 512   # keys per compute iteration (amortizes the per-iteration
+                     # flash-state relayout overhead; VMEM cost is
+                     # 2 bufs * 2 pools * Hkv * 512 * Dh * 2B)
+NUM_BUFS = 2         # superpage double buffering
+
+
+def _decode_kernel(
+    # scalar prefetch
+    block_tables_ref,   # SMEM [B, Mb] int32
+    kv_lens_ref,        # SMEM [B] int32
+    # inputs
+    q_ref,              # VMEM [1, H, Dh]
+    k_hbm,              # HBM  [Hkv, num_slots, Dh] (head-major)
+    v_hbm,              # HBM  [Hkv, num_slots, Dh]
+    # outputs
+    o_ref,              # VMEM [1, H, Dh]
+    # scratch
+    k_buf,              # VMEM [NUM_BUFS, Hkv, SUPER_TOKENS, Dh]
+    v_buf,              # VMEM [NUM_BUFS, Hkv, SUPER_TOKENS, Dh]
+    sem_k,              # DMA sems (NUM_BUFS, pages_per_super)
+    sem_v,              # DMA sems (NUM_BUFS, pages_per_super)
+    *,
+    block_size: int,
+    num_kv_heads: int,
+    q_per_kv: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    bs = block_size
+    spp = SUPER_TOKENS // bs            # pages per superpage
+    hkv, g = num_kv_heads, q_per_kv
+    dh = q_ref.shape[-1]
+    kv_len = kv_lens_ref[b]
+    n_pages = pl.cdiv(kv_len, bs)
+    n_super = pl.cdiv(kv_len, SUPER_TOKENS)
+
+    # q: [H, Dh] -> [Hkv, G, Dh] fp32, pre-scaled
+    q = q_ref[0].astype(jnp.float32).reshape(hkv, g, dh) * scale
+
+    def start_fetch(s, slot):
+        # Issue page-granular DMAs for superpage s (pages are scattered in
+        # the pool; each is contiguous). Static unroll keeps them all in
+        # flight at once.
+        for i in range(spp):
+            page = s * spp + i
+
+            @pl.when(page < n_pages)
+            def _():
+                blk = block_tables_ref[b, page]
+                start = blk * bs
+                pltpu.make_async_copy(
+                    k_hbm.at[:, pl.ds(start, bs)],
+                    k_buf.at[slot, :, pl.ds(i * bs, bs)],
+                    sem_k.at[slot, i],
+                ).start()
+                pltpu.make_async_copy(
+                    v_hbm.at[:, pl.ds(start, bs)],
+                    v_buf.at[slot, :, pl.ds(i * bs, bs)],
+                    sem_v.at[slot, i],
+                ).start()
+
+            @pl.when(page >= n_pages)
+            def _():
+                # Never-fetched tail pages must not hold NaN/Inf garbage:
+                # masked softmax weights are 0, but 0 * NaN = NaN inside the
+                # PV contraction would still poison the row.
+                k_buf[slot, :, pl.ds(i * bs, bs)] = jnp.zeros(
+                    (k_buf.shape[1], bs, k_buf.shape[3]), k_buf.dtype
+                )
+                v_buf[slot, :, pl.ds(i * bs, bs)] = jnp.zeros(
+                    (v_buf.shape[1], bs, v_buf.shape[3]), v_buf.dtype
+                )
+
+    def wait_fetch(s, slot):
+        for i in range(spp):
+            page = s * spp + i
+
+            @pl.when(page < n_pages)
+            def _():
+                pltpu.make_async_copy(
+                    k_hbm.at[:, pl.ds(0, bs)],
+                    k_buf.at[slot, :, pl.ds(i * bs, bs)],
+                    sem_k.at[slot, i],
+                ).wait()
+                pltpu.make_async_copy(
+                    v_hbm.at[:, pl.ds(0, bs)],
+                    v_buf.at[slot, :, pl.ds(i * bs, bs)],
+                    sem_v.at[slot, i],
+                ).wait()
+
+    start_fetch(0, 0)
+
+    def body(s, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(s, NUM_BUFS)
+
+        @pl.when(s + 1 < n_super)
+        def _():
+            start_fetch(s + 1, jax.lax.rem(s + 1, NUM_BUFS))
+
+        wait_fetch(s, slot)
+
+        k_sup = k_buf[slot]   # [Hkv, S, Dh] — head-major: batch dim leads,
+        v_sup = v_buf[slot]   # so NO per-superpage relayout is needed.
+
+        # scores: [Hkv, G, S]
+        scores = jax.lax.dot_general(
+            q, k_sup,
+            dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        # Mask slots at/past kv_len (tail + never-fetched pages).
+        pos = s * SUPER_TOKENS + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, SUPER_TOKENS), 2
+        )
+        scores = jnp.where(pos < kv_len, scores, -jnp.inf)
+
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p_ = jnp.exp(scores - m_new)               # [Hkv, G, S]
+        l_new = l * alpha + jnp.sum(p_, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p_, v_sup,
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        acc_new = acc * alpha + pv                 # [Hkv, G, Dh]
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((hkv, g, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((hkv, g, 1), jnp.float32)
+    acc0 = jnp.zeros((hkv, g, dh), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_super, body, (m0, l0, acc0))
+
+    out = acc / jnp.maximum(l, 1e-30)
+    o_ref[0] = out.reshape(hkv * g, dh).astype(o_ref.dtype)
+
+
+def supports_pallas_decode(head_dim: int, block_size: int) -> bool:
+    return head_dim % 128 == 0 and SUPER_TOKENS % block_size == 0
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_size", "scale", "interpret")
+)
+def paged_attention_decode_pallas(
+    q: jax.Array,             # [B, 1, H, Dh]
+    k_pool: jax.Array,        # [Hkv, num_slots, Dh] (head-major)
+    v_pool: jax.Array,        # [Hkv, num_slots, Dh]
+    block_tables: jax.Array,  # [B, Mb] int32
+    kv_lens: jax.Array,       # [B] int32
+    *,
+    block_size: int,
+    scale: Optional[float] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    b, t, h, dh = q.shape
+    assert t == 1, "pallas kernel is decode-only; prefill uses the XLA path"
+    hkv = k_pool.shape[0]
+    g = h // hkv
+    if scale is None:
+        scale = dh ** -0.5
+    spp = SUPER_TOKENS // block_size
+
+    kernel = functools.partial(
+        _decode_kernel,
+        block_size=block_size, num_kv_heads=hkv, q_per_kv=g,
+        scale=float(scale),
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec(
+                (1, h, dh), lambda i, *_: (i, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(memory_space=pltpu.HBM),  # pool stays off-chip;
+            pl.BlockSpec(memory_space=pltpu.HBM),  # kernel DMAs pages itself
+        ],
+        out_specs=pl.BlockSpec(
+            (1, h, dh), lambda i, *_: (i, 0, 0), memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((NUM_BUFS, hkv, SUPER_TOKENS, dh), k_pool.dtype),
+            pltpu.VMEM((NUM_BUFS, hkv, SUPER_TOKENS, dh), v_pool.dtype),
+            pltpu.SemaphoreType.DMA((NUM_BUFS, spp)),
+            pltpu.SemaphoreType.DMA((NUM_BUFS, spp)),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, h, dh), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(block_tables, kv_lens, q.reshape(b, h, dh), k_pool, v_pool)
+    return out.reshape(b, 1, h, dh)
+
+
+def paged_attention_pallas(
+    q, k_pool, v_pool, block_tables, kv_lens, q_positions,
+    *, block_size: int, scale: Optional[float] = None,
+    interpret: bool = False,
+):
+    """Dispatch: decode (T==1, dh%128==0) runs the flash-decode kernel;
+    everything else falls back to the XLA gather path."""
+    if q.shape[1] == 1 and supports_pallas_decode(q.shape[-1], block_size):
+        return paged_attention_decode_pallas(
+            q, k_pool, v_pool, block_tables, kv_lens,
+            block_size=block_size, scale=scale, interpret=interpret,
+        )
+    from production_stack_tpu.ops.attention import paged_attention_xla
+
+    return paged_attention_xla(
+        q, k_pool, v_pool, block_tables, kv_lens, q_positions,
+        block_size=block_size, scale=scale,
+    )

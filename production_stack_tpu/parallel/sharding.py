@@ -66,9 +66,9 @@ def param_shardings(cfg: ModelConfig, mesh: Mesh, params: Dict) -> Dict:
 
 
 def kv_pool_sharding(cfg: ModelConfig, mesh: Mesh) -> NamedSharding:
-    """KV pools [L, num_slots, Hkv, Dh]: shard kv heads over tp (matches the
+    """KV pools [L, Hkv, num_slots, Dh]: shard kv heads over tp (matches the
     head-sharded q/k/v activations, so paged attention needs no collectives).
     """
     return _shard_if_divisible(
-        mesh, cfg.num_kv_heads, (None, None, AXIS_TP, None)
+        mesh, cfg.num_kv_heads, (None, AXIS_TP, None, None)
     )

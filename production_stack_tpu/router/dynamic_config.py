@@ -88,6 +88,10 @@ class DynamicConfigWatcher:
             models = [
                 [m] for m in parse_static_model_names(config.static_models or "")
             ]
+            if len(models) == 1 and len(urls) > 1:
+                # Same broadcast rule as startup wiring (app.initialize_all):
+                # one model name means every backend serves it.
+                models = models * len(urls)
             reconfigure_service_discovery("static", urls=urls, models=models)
         elif config.service_discovery == "k8s":
             reconfigure_service_discovery(

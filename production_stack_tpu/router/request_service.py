@@ -140,6 +140,12 @@ async def proxy_request(
             await response.prepare(request)
             first = True
             full_chunks = []
+            # Only non-streamed responses are cacheable; buffering SSE bodies
+            # the cache would discard anyway just burns memory.
+            cacheable = (
+                app.get("semantic_cache") is not None
+                and body is not None and not body.get("stream")
+            )
             async for chunk in backend_resp.content.iter_any():
                 now = time.time()
                 if first:
@@ -147,7 +153,7 @@ async def proxy_request(
                     first = False
                 else:
                     monitor.on_request_token(backend_url, request_id, now)
-                if app.get("semantic_cache") is not None:
+                if cacheable:
                     full_chunks.append(chunk)
                 await response.write(chunk)
             monitor.on_request_complete(backend_url, request_id, time.time())
@@ -166,7 +172,7 @@ async def proxy_request(
         return response
 
     cache = app.get("semantic_cache")
-    if cache is not None and body is not None and backend_resp.status == 200:
+    if cache is not None and cacheable and backend_resp.status == 200:
         try:
             cache.store_response(body, b"".join(full_chunks))
         except Exception:  # noqa: BLE001 — cache store is best-effort

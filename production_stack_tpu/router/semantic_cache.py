@@ -74,6 +74,9 @@ class SemanticCache:
         self._vectors: Optional[np.ndarray] = None   # [N, dim]
         self._entries: List[Dict] = []
         self._lock = threading.Lock()
+        self._dirty = False
+        self._persist_thread: Optional[threading.Thread] = None
+        self.persist_interval = 5.0
         if persist_path and os.path.exists(persist_path):
             self._load()
 
@@ -112,7 +115,33 @@ class SemanticCache:
                 self._vectors = self._vectors[1:]
             cache_size.set(len(self._entries))
         if self.persist_path:
-            self._persist()
+            self._schedule_persist()
+
+    def _schedule_persist(self) -> None:
+        """Debounced background persistence: pickling the whole cache per
+        store on the event loop would stall request handling."""
+        with self._lock:
+            self._dirty = True
+            if self._persist_thread is not None and \
+                    self._persist_thread.is_alive():
+                return
+            self._persist_thread = threading.Thread(
+                target=self._persist_worker, daemon=True,
+                name="semantic-cache-persist",
+            )
+            self._persist_thread.start()
+
+    def _persist_worker(self) -> None:
+        while True:
+            with self._lock:
+                if not self._dirty:
+                    return
+                self._dirty = False
+            try:
+                self._persist()
+            except Exception:  # noqa: BLE001 — persistence is best-effort
+                logger.exception("Semantic cache persist failed")
+            time.sleep(self.persist_interval)
 
     def _persist(self) -> None:
         with self._lock:

@@ -140,9 +140,6 @@ class K8sPodIPServiceDiscovery(ServiceDiscovery):
     async def _watch_once(self) -> None:
         import aiohttp
 
-        params = {"watch": "true", "timeoutSeconds": "30"}
-        if self.label_selector:
-            params["labelSelector"] = self.label_selector
         headers = {}
         if self._token:
             headers["Authorization"] = f"Bearer {self._token}"
@@ -151,12 +148,41 @@ class K8sPodIPServiceDiscovery(ServiceDiscovery):
         if url.startswith("https"):
             conn_kwargs["ssl"] = self._ssl_context()
         timeout = aiohttp.ClientTimeout(total=None, sock_read=60)
+        base_params = {}
+        if self.label_selector:
+            base_params["labelSelector"] = self.label_selector
         # Pod event objects routinely exceed aiohttp's 64KiB line default
         # (managedFields, env, volumes); a too-small buffer would wedge the
         # watch in a reconnect loop on the same oversized event.
         async with aiohttp.ClientSession(
             timeout=timeout, read_bufsize=4 * 1024 * 1024
         ) as session:
+            # LIST + reconcile first: DELETED events lost across reconnects
+            # would otherwise leave dead pods routable forever.
+            async with session.get(
+                url, params=base_params, headers=headers, **conn_kwargs
+            ) as resp:
+                resp.raise_for_status()
+                listing = await resp.json()
+            resource_version = (listing.get("metadata") or {}).get(
+                "resourceVersion"
+            )
+            live_names = set()
+            for pod in listing.get("items", []):
+                name = (pod.get("metadata") or {}).get("name")
+                if name:
+                    live_names.add(name)
+                await self._on_pod_event(session, "ADDED", pod)
+            with self._lock:
+                for name in list(self._endpoints):
+                    if name not in live_names:
+                        logger.info("Discovery: reconciling away %s", name)
+                        del self._endpoints[name]
+            self._watch_alive = time.time()
+
+            params = {"watch": "true", "timeoutSeconds": "30", **base_params}
+            if resource_version:
+                params["resourceVersion"] = resource_version
             async with session.get(
                 url, params=params, headers=headers, **conn_kwargs
             ) as resp:

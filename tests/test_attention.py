@@ -35,7 +35,7 @@ def test_paged_matches_dense_decode_and_prefill():
     rng = np.random.default_rng(0)
     hkv, h, dh = 2, 4, 8
     num_blocks = 10
-    pool_shape = (num_blocks * BLOCK, hkv, dh)
+    pool_shape = (hkv, num_blocks * BLOCK, dh)  # head-major
     k_pool = jnp.asarray(rng.normal(size=pool_shape), jnp.float32)
     v_pool = jnp.asarray(rng.normal(size=pool_shape), jnp.float32)
 
@@ -46,8 +46,8 @@ def test_paged_matches_dense_decode_and_prefill():
 
     # Dense copies of the live KV, slot order -> sequence order.
     slots = [b * BLOCK + o for b in blocks for o in range(BLOCK)][:kv_len]
-    k_seq = np.asarray(k_pool)[slots]
-    v_seq = np.asarray(v_pool)[slots]
+    k_seq = np.asarray(k_pool)[:, slots].transpose(1, 0, 2)  # [S, Hkv, Dh]
+    v_seq = np.asarray(v_pool)[:, slots].transpose(1, 0, 2)
 
     # --- decode: 1 query at position kv_len-1
     q = jnp.asarray(rng.normal(size=(1, 1, h, dh)), jnp.float32)
@@ -78,16 +78,16 @@ def test_paged_matches_dense_decode_and_prefill():
 
 def test_write_kv_to_pool_scatter_and_null_block():
     hkv, dh = 2, 4
-    k_pool = jnp.zeros((8 * BLOCK, hkv, dh))
-    v_pool = jnp.zeros((8 * BLOCK, hkv, dh))
+    k_pool = jnp.zeros((hkv, 8 * BLOCK, dh))
+    v_pool = jnp.zeros((hkv, 8 * BLOCK, dh))
     k_new = jnp.ones((1, 3, hkv, dh))
     v_new = 2 * jnp.ones((1, 3, hkv, dh))
     # Two real tokens into block 2, one padding token to slot 0.
     slot_mapping = jnp.array([[2 * BLOCK, 2 * BLOCK + 1, 0]], jnp.int32)
     k_pool, v_pool = write_kv_to_pool(k_pool, v_pool, k_new, v_new, slot_mapping)
-    assert np.asarray(k_pool)[2 * BLOCK].sum() == hkv * dh
-    assert np.asarray(v_pool)[2 * BLOCK + 1].sum() == 2 * hkv * dh
+    assert np.asarray(k_pool)[:, 2 * BLOCK].sum() == hkv * dh
+    assert np.asarray(v_pool)[:, 2 * BLOCK + 1].sum() == 2 * hkv * dh
     # Null block received the padding write (harmless by design).
-    assert np.asarray(k_pool)[0].sum() == hkv * dh
+    assert np.asarray(k_pool)[:, 0].sum() == hkv * dh
     # Nothing else touched.
-    assert np.asarray(k_pool)[3 * BLOCK:].sum() == 0
+    assert np.asarray(k_pool)[:, 3 * BLOCK:].sum() == 0
