@@ -105,7 +105,24 @@ class ModelRunner:
             _setup_compilation_cache(config.compilation_cache_dir)
 
         init_fn, self._forward, self._logits_fn = get_model_fns(model_config)
-        if params is None:
+        import os
+
+        if params is None and config.load_format != "dummy" \
+                and os.path.isdir(config.model):
+            # Real checkpoint: shardings from the ABSTRACT tree, then each
+            # tensor stack goes host->device already TP-placed.
+            from production_stack_tpu.models.weights import load_hf_params
+
+            abstract = jax.eval_shape(
+                lambda: init_fn(
+                    model_config, jax.random.PRNGKey(0), self.dtype
+                )
+            )
+            shardings = param_shardings(model_config, mesh, abstract)
+            params = load_hf_params(
+                model_config, config.model, self.dtype, shardings
+            )
+        elif params is None:
             params = init_fn(
                 model_config, jax.random.PRNGKey(config.seed), self.dtype
             )

@@ -71,7 +71,9 @@ def _layer_body(cfg, block_size, attn_impl, hidden, lp,
     hidden = hidden + attn.reshape(b, t, h * dh) @ lp["wo"] + lp["bo"]
 
     x = layer_norm(hidden, lp["ln2_w"], lp["ln2_b"])
-    mlp = jax.nn.gelu(x @ lp["fc1"] + lp["fc1_b"], approximate=False) @ lp["fc2"] + lp["fc2_b"]
+    # OPT's activation is ReLU (HF OPTConfig.activation_function default,
+    # used by facebook/opt-125m), not GELU.
+    mlp = jax.nn.relu(x @ lp["fc1"] + lp["fc1_b"]) @ lp["fc2"] + lp["fc2_b"]
     return hidden + mlp, k_pool, v_pool
 
 

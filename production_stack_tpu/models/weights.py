@@ -1,0 +1,184 @@
+"""HuggingFace checkpoint loading into the stacked-layer param tree.
+
+The reference stack loads weights inside external vLLM images from a PVC/HF
+cache (reference helm/templates/deployment-vllm-multi.yaml:144-150,
+tutorials/03-load-model-from-pv.md). Here loading is in-repo and TPU-shaped:
+
+  * Source: a LOCAL model directory (zero-egress environment) containing
+    ``*.safetensors`` shards (preferred) or ``pytorch_model*.bin``.
+  * Per-tensor streaming: each HF tensor is read, transposed to our
+    [in, out] convention, written into a preallocated numpy stack
+    ``[L, ...]``, and the completed stack is ``jax.device_put`` with its
+    TP sharding immediately — peak host memory is one param stack, not
+    the whole checkpoint.
+"""
+
+import os
+import re
+from typing import Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+from production_stack_tpu.models.config import ModelConfig
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+_LAYER_RE = re.compile(r"\.(?:layers|decoder\.layers)\.(\d+)\.")
+
+# HF suffix -> (our leaf name, transpose?) for llama-family models.
+_LLAMA_MAP = {
+    "self_attn.q_proj.weight": ("wq", True),
+    "self_attn.k_proj.weight": ("wk", True),
+    "self_attn.v_proj.weight": ("wv", True),
+    "self_attn.o_proj.weight": ("wo", True),
+    "self_attn.q_proj.bias": ("bq", False),
+    "self_attn.k_proj.bias": ("bk", False),
+    "self_attn.v_proj.bias": ("bv", False),
+    "mlp.gate_proj.weight": ("w_gate", True),
+    "mlp.up_proj.weight": ("w_up", True),
+    "mlp.down_proj.weight": ("w_down", True),
+    "input_layernorm.weight": ("attn_norm", False),
+    "post_attention_layernorm.weight": ("mlp_norm", False),
+}
+_LLAMA_TOP = {
+    "model.embed_tokens.weight": ("embed", False),
+    "model.norm.weight": ("final_norm", False),
+    "lm_head.weight": ("lm_head", True),
+}
+
+_OPT_MAP = {
+    "self_attn.q_proj.weight": ("wq", True),
+    "self_attn.k_proj.weight": ("wk", True),
+    "self_attn.v_proj.weight": ("wv", True),
+    "self_attn.out_proj.weight": ("wo", True),
+    "self_attn.q_proj.bias": ("bq", False),
+    "self_attn.k_proj.bias": ("bk", False),
+    "self_attn.v_proj.bias": ("bv", False),
+    "self_attn.out_proj.bias": ("bo", False),
+    "self_attn_layer_norm.weight": ("ln1_w", False),
+    "self_attn_layer_norm.bias": ("ln1_b", False),
+    "final_layer_norm.weight": ("ln2_w", False),
+    "final_layer_norm.bias": ("ln2_b", False),
+    "fc1.weight": ("fc1", True),
+    "fc1.bias": ("fc1_b", False),
+    "fc2.weight": ("fc2", True),
+    "fc2.bias": ("fc2_b", False),
+}
+_OPT_TOP = {
+    "model.decoder.embed_tokens.weight": ("embed", False),
+    "model.decoder.embed_positions.weight": ("pos_embed", False),
+    "model.decoder.final_layer_norm.weight": ("final_ln_w", False),
+    "model.decoder.final_layer_norm.bias": ("final_ln_b", False),
+}
+
+
+def _iter_checkpoint_tensors(model_dir: str) -> Iterator[Tuple[str, np.ndarray]]:
+    """Yield (hf_name, numpy array) streaming over checkpoint shards."""
+    st_files = sorted(
+        f for f in os.listdir(model_dir) if f.endswith(".safetensors")
+    )
+    if st_files:
+        from safetensors import safe_open
+
+        for fname in st_files:
+            with safe_open(os.path.join(model_dir, fname), framework="np") as f:
+                for name in f.keys():
+                    yield name, f.get_tensor(name)
+        return
+    bin_files = sorted(
+        f for f in os.listdir(model_dir)
+        if f.startswith("pytorch_model") and f.endswith(".bin")
+    )
+    if not bin_files:
+        raise FileNotFoundError(
+            f"No *.safetensors or pytorch_model*.bin in {model_dir}"
+        )
+    import torch
+
+    for fname in bin_files:
+        state = torch.load(
+            os.path.join(model_dir, fname), map_location="cpu",
+            weights_only=True,
+        )
+        for name, tensor in state.items():
+            yield name, tensor.to(torch.float32).numpy()
+
+
+def load_hf_params(
+    cfg: ModelConfig,
+    model_dir: str,
+    dtype,
+    shardings: Optional[Dict] = None,
+) -> Dict:
+    """Load an HF checkpoint into the stacked-layer tree used by
+    models/llama.py and models/opt.py, device_put'ing each completed stack.
+
+    ``shardings``: optional pytree (same structure as the result) of
+    NamedShardings — each leaf goes straight to its TP shard placement.
+    """
+    import jax
+
+    per_layer_map = _LLAMA_MAP if cfg.arch == "llama" else _OPT_MAP
+    top_map = _LLAMA_TOP if cfg.arch == "llama" else _OPT_TOP
+    nl = cfg.num_layers
+
+    stacks: Dict[str, np.ndarray] = {}   # our layer leaf -> [L, ...] buffer
+    filled: Dict[str, int] = {}
+    top: Dict[str, np.ndarray] = {}
+
+    for hf_name, tensor in _iter_checkpoint_tensors(model_dir):
+        m = _LAYER_RE.search(hf_name)
+        if m is not None:
+            layer_idx = int(m.group(1))
+            suffix = hf_name[m.end():]
+            mapped = per_layer_map.get(suffix)
+            if mapped is None:
+                logger.debug("Skipping unmapped tensor %s", hf_name)
+                continue
+            ours, transpose = mapped
+            t = tensor.T if transpose else tensor
+            if ours not in stacks:
+                stacks[ours] = np.empty((nl,) + t.shape, t.dtype)
+                filled[ours] = 0
+            stacks[ours][layer_idx] = t
+            filled[ours] += 1
+        else:
+            mapped = top_map.get(hf_name)
+            if mapped is None:
+                logger.debug("Skipping unmapped tensor %s", hf_name)
+                continue
+            ours, transpose = mapped
+            top[ours] = tensor.T if transpose else tensor
+
+    missing = [k for k, n in filled.items() if n != nl]
+    if missing:
+        raise ValueError(
+            f"Incomplete checkpoint: {missing} have "
+            f"{[filled[k] for k in missing]} of {nl} layers"
+        )
+
+    params: Dict = {"layers": {}}
+    for name in list(stacks):
+        arr = jax.numpy.asarray(stacks[name], dtype=dtype)
+        if shardings is not None and name in shardings.get("layers", {}):
+            arr = jax.device_put(arr, shardings["layers"][name])
+        params["layers"][name] = arr
+        stacks[name] = None  # free host memory promptly
+    for name, leaf in top.items():
+        arr = jax.numpy.asarray(leaf, dtype=dtype)
+        if shardings is not None and name in shardings:
+            arr = jax.device_put(arr, shardings[name])
+        params[name] = arr
+
+    if cfg.arch == "llama" and cfg.tie_word_embeddings:
+        params.pop("lm_head", None)
+    if cfg.arch == "llama" and "lm_head" not in params \
+            and not cfg.tie_word_embeddings and "embed" in params:
+        # Checkpoints sometimes omit lm_head when tied; honor the config.
+        logger.warning("lm_head missing; falling back to tied embeddings")
+    logger.info(
+        "Loaded %d layer stacks + %d top-level tensors from %s",
+        len(params["layers"]), len(top), model_dir,
+    )
+    return params
