@@ -1,0 +1,234 @@
+// Shared KV cache server — the cache-server tier of the stack.
+//
+// The reference deploys `lmcache_experimental_server` as a standalone pod
+// (reference helm/templates/deployment-cache-server.yaml:29-51) that engines
+// reach over TCP (LMCACHE_REMOTE_URL). This is the TPU stack's native
+// equivalent: a C++ blob store keyed by KV block hashes, LRU-bounded, with
+// the length-prefixed protocol documented in
+// production_stack_tpu/kv_offload/remote.py:
+//
+//   request:  op(1) | key_len(u32 LE) | key | val_len(u64 LE) | val
+//   response: status(1: 0=ok, 1=missing, 2=error) | val_len(u64 LE) | val
+//   ops: 'P' put, 'G' get, 'E' exists, 'T' stats (JSON)
+//
+// Thread-per-connection (engine pods hold one connection each; connection
+// count is small), one global mutex around the store (operations are
+// memcpy-bound; the mutex is held only for map/LRU bookkeeping and the
+// value move, not for socket IO).
+//
+// Build: make -C native   (produces build/kv_server)
+// Run:   kv_server [--port 8200] [--max-bytes 34359738368]
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <list>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+#include <cstdio>
+#include <csignal>
+
+namespace {
+
+struct Store {
+  struct Entry {
+    std::string value;
+    std::list<std::string>::iterator lru_it;
+  };
+
+  std::mutex mu;
+  std::unordered_map<std::string, Entry> map;
+  std::list<std::string> lru;  // front = most recent
+  size_t bytes = 0;
+  size_t max_bytes;
+  std::atomic<uint64_t> hits{0}, misses{0}, stores{0}, evictions{0};
+
+  explicit Store(size_t max) : max_bytes(max) {}
+
+  void put(const std::string& key, std::string&& value) {
+    std::lock_guard<std::mutex> lock(mu);
+    auto it = map.find(key);
+    if (it != map.end()) {
+      bytes -= it->second.value.size();
+      lru.erase(it->second.lru_it);
+      map.erase(it);
+    }
+    bytes += value.size();
+    lru.push_front(key);
+    map.emplace(key, Entry{std::move(value), lru.begin()});
+    stores++;
+    while (bytes > max_bytes && !lru.empty()) {
+      const std::string& victim = lru.back();
+      auto vit = map.find(victim);
+      if (vit != map.end()) {
+        bytes -= vit->second.value.size();
+        map.erase(vit);
+      }
+      lru.pop_back();
+      evictions++;
+    }
+  }
+
+  bool get(const std::string& key, std::string* out) {
+    std::lock_guard<std::mutex> lock(mu);
+    auto it = map.find(key);
+    if (it == map.end()) {
+      misses++;
+      return false;
+    }
+    lru.erase(it->second.lru_it);
+    lru.push_front(key);
+    it->second.lru_it = lru.begin();
+    *out = it->second.value;  // copy so IO happens outside the lock
+    hits++;
+    return true;
+  }
+
+  bool exists(const std::string& key) {
+    std::lock_guard<std::mutex> lock(mu);
+    return map.find(key) != map.end();
+  }
+
+  std::string stats_json() {
+    std::lock_guard<std::mutex> lock(mu);
+    char buf[512];
+    snprintf(buf, sizeof(buf),
+             "{\"entries\": %zu, \"bytes\": %zu, \"max_bytes\": %zu, "
+             "\"hits\": %llu, \"misses\": %llu, \"stores\": %llu, "
+             "\"evictions\": %llu}",
+             map.size(), bytes, max_bytes,
+             (unsigned long long)hits.load(),
+             (unsigned long long)misses.load(),
+             (unsigned long long)stores.load(),
+             (unsigned long long)evictions.load());
+    return buf;
+  }
+};
+
+bool recv_exact(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = send(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_response(int fd, uint8_t status, const std::string& payload) {
+  uint64_t vlen = payload.size();
+  char header[9];
+  header[0] = static_cast<char>(status);
+  memcpy(header + 1, &vlen, 8);  // little-endian host assumed (x86/arm64)
+  if (!send_all(fd, header, 9)) return false;
+  if (vlen && !send_all(fd, payload.data(), vlen)) return false;
+  return true;
+}
+
+constexpr size_t kMaxKeyLen = 1 << 16;
+constexpr size_t kMaxValLen = 1ULL << 32;  // 4 GiB per block is already absurd
+
+void serve_connection(int fd, Store* store) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  for (;;) {
+    char op;
+    uint32_t klen;
+    uint64_t vlen;
+    if (!recv_exact(fd, &op, 1)) break;
+    if (!recv_exact(fd, &klen, 4)) break;
+    if (klen > kMaxKeyLen) break;
+    std::string key(klen, '\0');
+    if (klen && !recv_exact(fd, key.data(), klen)) break;
+    if (!recv_exact(fd, &vlen, 8)) break;
+    if (vlen > kMaxValLen) break;
+    std::string val(vlen, '\0');
+    if (vlen && !recv_exact(fd, val.data(), vlen)) break;
+
+    bool ok = true;
+    switch (op) {
+      case 'P':
+        store->put(key, std::move(val));
+        ok = send_response(fd, 0, "");
+        break;
+      case 'G': {
+        std::string out;
+        if (store->get(key, &out)) {
+          ok = send_response(fd, 0, out);
+        } else {
+          ok = send_response(fd, 1, "");
+        }
+        break;
+      }
+      case 'E':
+        ok = send_response(fd, store->exists(key) ? 0 : 1, "");
+        break;
+      case 'T':
+        ok = send_response(fd, 0, store->stats_json());
+        break;
+      default:
+        ok = send_response(fd, 2, "");
+        break;
+    }
+    if (!ok) break;
+  }
+  close(fd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = 8200;
+  size_t max_bytes = 32ULL << 30;
+  for (int i = 1; i + 1 < argc; i += 2) {
+    if (!strcmp(argv[i], "--port")) port = atoi(argv[i + 1]);
+    if (!strcmp(argv[i], "--max-bytes")) max_bytes = strtoull(argv[i + 1], nullptr, 10);
+  }
+  signal(SIGPIPE, SIG_IGN);
+
+  int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = INADDR_ANY;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(lfd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    perror("bind");
+    return 1;
+  }
+  if (listen(lfd, 128) != 0) {
+    perror("listen");
+    return 1;
+  }
+  fprintf(stderr, "kv_server listening on :%d (max %zu bytes)\n", port,
+          max_bytes);
+
+  Store store(max_bytes);
+  for (;;) {
+    int cfd = accept(lfd, nullptr, nullptr);
+    if (cfd < 0) continue;
+    std::thread(serve_connection, cfd, &store).detach();
+  }
+}

@@ -83,7 +83,21 @@ class ServingEngine:
             self.runner.num_kv_blocks, config.block_size,
             config.enable_prefix_caching,
         )
-        self.scheduler = Scheduler(config, self.block_manager)
+        self.offload = None
+        if config.kv_offload_cpu or config.kv_remote_url:
+            from production_stack_tpu.kv_offload import KVOffloadManager
+
+            gb = config.kv_offload_max_cpu_gb or 4.0
+            self.offload = KVOffloadManager(
+                self.runner, self.block_manager,
+                host_pool_bytes=(
+                    int(gb * (1 << 30)) if config.kv_offload_cpu else 0
+                ),
+                remote_url=config.kv_remote_url,
+                serde=config.kv_remote_serde,
+            )
+        self.scheduler = Scheduler(config, self.block_manager,
+                                   offload=self.offload)
 
         self._streams: Dict[str, _StreamState] = {}
         self._pending_aborts: Set[str] = set()
@@ -117,6 +131,8 @@ class ServingEngine:
         if self._loop_task:
             await self._loop_task
             self._loop_task = None
+        if self.offload is not None:
+            self.offload.close()
 
     @property
     def is_healthy(self) -> bool:

@@ -50,6 +50,9 @@ class BlockPoolManager:
         self._block_to_hash: Dict[int, bytes] = {}
         # evictable: blocks with ref 0 still holding cached content (LRU order)
         self._evictable: "OrderedDict[int, None]" = OrderedDict()
+        # blocks queued for offload spill: excluded from eviction until the
+        # device->host read completes (production_stack_tpu/kv_offload/manager.py)
+        self._spill_pinned: set = set()
         # prefix-cache counters (token granularity, monotonic)
         self.prefix_queries_total = 0
         self.prefix_hits_total = 0
@@ -71,14 +74,27 @@ class BlockPoolManager:
     def _pop_free_block(self) -> Optional[int]:
         if self._free:
             return self._free.pop()
-        if self._evictable:
-            # Reclaim least-recently-used cached block.
-            blk, _ = self._evictable.popitem(last=False)
+        # Reclaim the least-recently-used cached block, skipping any pinned
+        # for an in-flight offload spill.
+        for blk in self._evictable:
+            if blk in self._spill_pinned:
+                continue
+            del self._evictable[blk]
             h = self._block_to_hash.pop(blk, None)
             if h is not None:
                 self._hash_to_block.pop(h, None)
             return blk
         return None
+
+    # ---------------------------------------------------------- offload hooks
+    def pin_for_spill(self, blk: int) -> None:
+        self._spill_pinned.add(blk)
+
+    def unpin_for_spill(self, blk: int) -> None:
+        self._spill_pinned.discard(blk)
+
+    def hash_of_block(self, blk: int) -> Optional[bytes]:
+        return self._block_to_hash.get(blk)
 
     def can_allocate(self, n: int) -> bool:
         return self.num_free_blocks >= n

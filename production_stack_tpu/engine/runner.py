@@ -334,6 +334,71 @@ class ModelRunner:
             return [[]]
         return [[int(np.asarray(next_tokens)[0])]]
 
+    # ------------------------------------------------------------ KV offload
+    def _block_slots(self, block_ids: List[int], n_bucket: int) -> np.ndarray:
+        bs = self.config.block_size
+        slots = np.zeros((n_bucket * bs,), np.int32)  # padding -> null block
+        for i, blk in enumerate(block_ids):
+            slots[i * bs:(i + 1) * bs] = np.arange(blk * bs, (blk + 1) * bs)
+        return slots
+
+    @functools.cached_property
+    def _gather_blocks_jit(self):
+        def gather(kv_k, kv_v, slots):
+            return kv_k[:, :, slots], kv_v[:, :, slots]
+        return jax.jit(gather)
+
+    @functools.cached_property
+    def _scatter_blocks_jit(self):
+        def scatter(kv_k, kv_v, slots, k_new, v_new):
+            return (
+                kv_k.at[:, :, slots].set(k_new.astype(kv_k.dtype)),
+                kv_v.at[:, :, slots].set(v_new.astype(kv_v.dtype)),
+            )
+        return jax.jit(scatter, donate_argnums=(0, 1))
+
+    def read_blocks(self, block_ids: List[int]):
+        """Device->host read of whole KV blocks.
+
+        Returns (k, v) numpy arrays [n, L, Hkv, bs, Dh]. May raise
+        RuntimeError if a concurrent step donated the pool buffers mid-read
+        (the offload spiller retries against the rebound arrays).
+        """
+        bs = self.config.block_size
+        n = len(block_ids)
+        nb = _bucket(n, 1, max(1, self.num_kv_blocks))
+        slots = jnp.asarray(self._block_slots(block_ids, nb))
+        k_g, v_g = self._gather_blocks_jit(self.kv_k, self.kv_v, slots)
+        k_np = np.asarray(k_g)   # [L, Hkv, nb*bs, Dh]
+        v_np = np.asarray(v_g)
+        nl, hkv, _, dh = k_np.shape
+        k_np = k_np.reshape(nl, hkv, nb, bs, dh).transpose(2, 0, 1, 3, 4)[:n]
+        v_np = v_np.reshape(nl, hkv, nb, bs, dh).transpose(2, 0, 1, 3, 4)[:n]
+        return k_np, v_np
+
+    def write_blocks(self, block_ids: List[int], k_np, v_np) -> None:
+        """Host->device restore of whole KV blocks.
+
+        k_np/v_np: [n, L, Hkv, bs, Dh]. Runs on the engine loop between
+        steps, so the donated update is ordered with model dispatches.
+        """
+        bs = self.config.block_size
+        n = len(block_ids)
+        nb = _bucket(n, 1, max(1, self.num_kv_blocks))
+        nl, hkv, dh = k_np.shape[1], k_np.shape[2], k_np.shape[4]
+        if nb != n:
+            pad = np.zeros((nb - n,) + k_np.shape[1:], k_np.dtype)
+            k_np = np.concatenate([k_np, pad])
+            v_np = np.concatenate([v_np, pad])
+        # [nb, L, Hkv, bs, Dh] -> [L, Hkv, nb*bs, Dh]
+        k_flat = k_np.transpose(1, 2, 0, 3, 4).reshape(nl, hkv, nb * bs, dh)
+        v_flat = v_np.transpose(1, 2, 0, 3, 4).reshape(nl, hkv, nb * bs, dh)
+        slots = jnp.asarray(self._block_slots(block_ids, nb))
+        self.kv_k, self.kv_v = self._scatter_blocks_jit(
+            self.kv_k, self.kv_v, slots, jnp.asarray(k_flat),
+            jnp.asarray(v_flat),
+        )
+
     # ------------------------------------------------------------- maintenance
     def warmup(self) -> None:
         """Pre-compile the most common shape families."""

@@ -105,9 +105,11 @@ class ScheduledBatch:
 
 
 class Scheduler:
-    def __init__(self, config: EngineConfig, block_manager: BlockPoolManager):
+    def __init__(self, config: EngineConfig, block_manager: BlockPoolManager,
+                 offload=None):
         self.config = config
         self.block_manager = block_manager
+        self.offload = offload  # KVOffloadManager (host/remote KV tiers)
         self.waiting: Deque[Sequence] = deque()
         self.running: List[Sequence] = []
         self.seqs: Dict[str, Sequence] = {}
@@ -180,6 +182,15 @@ class Scheduler:
             if alloc is not None:
                 cand.block_ids, cand.num_cached_tokens = alloc
                 cand.num_computed_tokens = cand.num_cached_tokens
+                if self.offload is not None:
+                    # Host/remote KV tiers may extend the cached prefix past
+                    # what survived in device HBM (LMCache-equivalent path).
+                    restored = self.offload.try_restore(
+                        cand.all_token_ids, cand.block_ids,
+                        cand.num_computed_tokens,
+                    )
+                    cand.num_computed_tokens += restored
+                    cand.num_cached_tokens += restored
                 seq = cand
                 break
         if seq is None:
@@ -353,5 +364,7 @@ class Scheduler:
             h = self.block_manager.register_full_block(
                 seq.block_ids[i], seq._prev_hash, tokens[i * bs:(i + 1) * bs]
             )
+            if self.offload is not None:
+                self.offload.on_block_registered(h, seq.block_ids[i])
             seq._prev_hash = h
             seq._num_hashed_blocks += 1

@@ -1,0 +1,19 @@
+"""KV-cache offload tiers (the LMCache-equivalent subsystem).
+
+The reference wires LMCache into its engines for HBM->CPU KV spill and a
+remote shared KV server (reference helm/templates/deployment-vllm-multi.yaml:191-216
+env: LMCACHE_LOCAL_CPU, LMCACHE_MAX_LOCAL_CPU_SIZE, LMCACHE_REMOTE_URL,
+LMCACHE_REMOTE_SERDE; server deployment-cache-server.yaml). Here:
+
+  * ``host_pool``  — in-process CPU RAM tier (block-hash -> KV bytes, LRU).
+  * ``remote``     — TCP client to the shared cache server (serde pluggable;
+                     "naive" = raw dtype bytes, like LMCache's serde option).
+  * ``server``     — the cache-server process (C++ core via
+                     native/kv_server.cpp when built, pure-Python fallback).
+  * ``manager``    — engine-facing orchestration: write-through spill of
+                     newly-full device blocks, prefix restore into freshly
+                     allocated blocks at prompt admission.
+"""
+
+from production_stack_tpu.kv_offload.host_pool import HostKVPool  # noqa: F401
+from production_stack_tpu.kv_offload.manager import KVOffloadManager  # noqa: F401

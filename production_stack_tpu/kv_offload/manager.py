@@ -1,0 +1,217 @@
+"""Engine-facing KV offload orchestration.
+
+Write path (spill): when a device block becomes full and content-addressed,
+it is queued; a background spiller thread batches device->host reads, packs
+blocks with the configured serde, and write-throughs to the host pool and
+(if configured) the remote cache server. Blocks queued for spill are PINNED
+in the device block manager so eviction can't recycle them mid-read; a
+hash re-check after the read drops stale entries.
+
+Read path (restore): at prompt admission, after the device prefix cache is
+consulted, the scheduler asks this manager for the NEXT consecutive full
+blocks by hash. Hits are unpacked and scattered straight into the freshly
+allocated device blocks; the sequence's computed-token counter advances so
+prefill skips the restored region. Restored blocks are re-registered by the
+normal full-block bookkeeping afterwards.
+
+This mirrors LMCache semantics (reference env wiring
+deployment-vllm-multi.yaml:191-216): local CPU tier bounded by
+LMCACHE_MAX_LOCAL_CPU_SIZE, remote tier at LMCACHE_REMOTE_URL.
+"""
+
+import threading
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from production_stack_tpu.engine.kv_cache import BlockPoolManager, _block_hash
+from production_stack_tpu.kv_offload.host_pool import HostKVPool
+from production_stack_tpu.kv_offload.serde import get_serde
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+
+class KVOffloadManager:
+    def __init__(
+        self,
+        runner,
+        block_manager: BlockPoolManager,
+        host_pool_bytes: int = 0,
+        remote_url: Optional[str] = None,
+        serde: str = "naive",
+        flush_interval: float = 0.1,
+        spill_batch: int = 8,
+    ):
+        self.runner = runner
+        self.block_manager = block_manager
+        self.host_pool = HostKVPool(host_pool_bytes) if host_pool_bytes else None
+        self.remote = None
+        if remote_url:
+            from production_stack_tpu.kv_offload.remote import RemoteKVClient
+
+            self.remote = RemoteKVClient(remote_url)
+        self.pack, self.unpack = get_serde(serde)
+        self.flush_interval = flush_interval
+        self.spill_batch = spill_batch
+
+        self._queue: List[Tuple[bytes, int]] = []
+        self._queued_hashes = set()
+        self._lock = threading.Lock()
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._spill_worker, daemon=True, name="kv-spiller"
+        )
+        self._thread.start()
+        # telemetry
+        self.restored_tokens_total = 0
+        self.spilled_blocks_total = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.host_pool is not None or self.remote is not None
+
+    # -------------------------------------------------------------- write path
+    def on_block_registered(self, h: bytes, blk: int) -> None:
+        """Engine-loop hook: a block just became full + content-addressed."""
+        if not self.enabled or not h:
+            return
+        if self.host_pool is not None and self.host_pool.contains(h):
+            return
+        with self._lock:
+            if h in self._queued_hashes:
+                return
+            self._queued_hashes.add(h)
+            self._queue.append((h, blk))
+        self.block_manager.pin_for_spill(blk)
+
+    def _spill_worker(self) -> None:
+        while self._running:
+            time.sleep(self.flush_interval)
+            with self._lock:
+                batch = self._queue[: self.spill_batch]
+                self._queue = self._queue[self.spill_batch:]
+            if not batch:
+                continue
+            try:
+                self._spill_batch(batch)
+            except Exception:  # noqa: BLE001 — offload is best-effort
+                logger.exception("KV spill batch failed")
+            finally:
+                for h, blk in batch:
+                    self.block_manager.unpin_for_spill(blk)
+                    with self._lock:
+                        self._queued_hashes.discard(h)
+
+    def _spill_batch(self, batch: List[Tuple[bytes, int]]) -> None:
+        # Drop entries whose block was recycled since registration.
+        live = [
+            (h, blk) for h, blk in batch
+            if self.block_manager.hash_of_block(blk) == h
+        ]
+        if not live:
+            return
+        blks = [blk for _, blk in live]
+        for attempt in range(3):
+            try:
+                k_np, v_np = self.runner.read_blocks(blks)
+                break
+            except RuntimeError:
+                # The engine step donated the pool buffers mid-read; retry
+                # against the rebound arrays.
+                if attempt == 2:
+                    raise
+                time.sleep(0.01)
+        for i, (h, blk) in enumerate(live):
+            if self.block_manager.hash_of_block(blk) != h:
+                continue  # recycled during the read; data is unreliable
+            blob = self.pack(k_np[i], v_np[i])
+            if self.host_pool is not None:
+                self.host_pool.put(h, blob)
+            if self.remote is not None:
+                try:
+                    self.remote.put(h, blob)
+                except ConnectionError as e:
+                    logger.warning("Remote KV put failed: %s", e)
+            self.spilled_blocks_total += 1
+
+    # --------------------------------------------------------------- read path
+    def _fetch(self, h: bytes) -> Optional[bytes]:
+        if self.host_pool is not None:
+            blob = self.host_pool.get(h)
+            if blob is not None:
+                return blob
+        if self.remote is not None:
+            try:
+                blob = self.remote.get(h)
+            except ConnectionError as e:
+                logger.warning("Remote KV get failed: %s", e)
+                return None
+            if blob is not None and self.host_pool is not None:
+                self.host_pool.put(h, blob)  # promote to the local tier
+            return blob
+        return None
+
+    def try_restore(
+        self,
+        token_ids: Sequence[int],
+        block_ids: Sequence[int],
+        num_computed_tokens: int,
+    ) -> int:
+        """Restore consecutive full blocks after the device-cached prefix.
+
+        Returns the number of tokens restored (multiple of block_size).
+        Called on the engine loop between device steps, so the scatter into
+        the pools is ordered with model steps.
+        """
+        if not self.enabled:
+            return 0
+        bs = self.block_manager.block_size
+        if num_computed_tokens % bs != 0:
+            return 0  # device cache ended mid-block: nothing contiguous to add
+        # Hash chain up to the restore boundary.
+        prev = b""
+        for i in range(num_computed_tokens // bs):
+            prev = _block_hash(
+                prev, token_ids[i * bs:(i + 1) * bs]
+            )
+        # At least one token must remain for prefill to compute logits from.
+        max_full = (len(token_ids) - 1) // bs
+        start_blk = num_computed_tokens // bs
+        hits: List[Tuple[int, np.ndarray, np.ndarray]] = []
+        for i in range(start_blk, max_full):
+            h = _block_hash(prev, token_ids[i * bs:(i + 1) * bs])
+            blob = self._fetch(h)
+            if blob is None:
+                break
+            k, v = self.unpack(blob)
+            hits.append((block_ids[i], k, v))
+            prev = h
+        if not hits:
+            return 0
+        blks = [b for b, _, _ in hits]
+        k_np = np.stack([k for _, k, _ in hits])
+        v_np = np.stack([v for _, _, v in hits])
+        self.runner.write_blocks(blks, k_np, v_np)
+        restored = len(hits) * bs
+        self.restored_tokens_total += restored
+        # Offload hits count toward the prefix-cache telemetry the router's
+        # cache-aware logic consumes (LMCache hits do the same upstream).
+        self.block_manager.prefix_hits_total += restored
+        logger.debug("Restored %d tokens from KV offload", restored)
+        return restored
+
+    def stats(self) -> dict:
+        out = {
+            "restored_tokens_total": self.restored_tokens_total,
+            "spilled_blocks_total": self.spilled_blocks_total,
+        }
+        if self.host_pool is not None:
+            out["host_pool"] = self.host_pool.stats()
+        return out
+
+    def close(self) -> None:
+        self._running = False
+        if self.remote is not None:
+            self.remote.close()

@@ -1,0 +1,110 @@
+"""TCP client for the shared KV cache server.
+
+The engine-side analogue of LMCACHE_REMOTE_URL wiring (reference
+helm/templates/deployment-vllm-multi.yaml:210-215). Wire protocol (shared
+with native/kv_server.cpp and the Python fallback server):
+
+  request:  op(1) | key_len(u32 LE) | key | val_len(u64 LE) | val
+  response: status(1: 0=ok, 1=missing, 2=error) | val_len(u64 LE) | val
+
+ops: 'P' put, 'G' get, 'E' exists, 'T' stats(JSON). One request in flight
+per connection; the client serializes with a lock (callers run on the
+engine's spiller thread, never the event loop).
+"""
+
+import json
+import socket
+import struct
+import threading
+from typing import Optional
+from urllib.parse import urlparse
+
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+STATUS_OK = 0
+STATUS_MISSING = 1
+STATUS_ERROR = 2
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("KV server closed connection")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class RemoteKVClient:
+    def __init__(self, url: str, connect_timeout: float = 5.0,
+                 io_timeout: float = 30.0):
+        """url: ``kv://host:port`` (also accepts ``tcp://`` / bare host:port,
+        mirroring the reference's LMCACHE_REMOTE_URL shape)."""
+        parsed = urlparse(url if "//" in url else f"kv://{url}")
+        self.host = parsed.hostname or "localhost"
+        self.port = parsed.port or 8200
+        self.connect_timeout = connect_timeout
+        self.io_timeout = io_timeout
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def _ensure_sock(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.create_connection(
+                (self.host, self.port), timeout=self.connect_timeout
+            )
+            s.settimeout(self.io_timeout)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = s
+        return self._sock
+
+    def _request(self, op: bytes, key: bytes, val: bytes = b""):
+        with self._lock:
+            try:
+                sock = self._ensure_sock()
+                sock.sendall(
+                    op + struct.pack("<I", len(key)) + key
+                    + struct.pack("<Q", len(val)) + val
+                )
+                status = _recv_exact(sock, 1)[0]
+                (vlen,) = struct.unpack("<Q", _recv_exact(sock, 8))
+                payload = _recv_exact(sock, vlen) if vlen else b""
+                return status, payload
+            except (OSError, ConnectionError) as e:
+                # Drop the connection; next call reconnects.
+                if self._sock is not None:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+                raise ConnectionError(f"KV server request failed: {e}") from e
+
+    # ------------------------------------------------------------------- API
+    def put(self, key: bytes, blob: bytes) -> bool:
+        status, _ = self._request(b"P", key, blob)
+        return status == STATUS_OK
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        status, payload = self._request(b"G", key)
+        return payload if status == STATUS_OK else None
+
+    def exists(self, key: bytes) -> bool:
+        status, _ = self._request(b"E", key)
+        return status == STATUS_OK
+
+    def stats(self) -> dict:
+        status, payload = self._request(b"T", b"")
+        return json.loads(payload) if status == STATUS_OK else {}
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None

@@ -1,0 +1,55 @@
+"""KV block (de)serialization.
+
+"naive" serde = raw little-endian dtype bytes prefixed by a fixed header, the
+same spirit as the reference's ``serde: "naive"`` LMCache option (reference
+tutorials/assets/values-06-shared-storage.yaml). One value packs a block's K
+and V: two arrays of shape [L, Hkv, block_size, Dh].
+"""
+
+import struct
+from typing import Tuple
+
+import numpy as np
+
+_MAGIC = b"PKV1"
+_DTYPES = {0: "bfloat16", 1: "float32", 2: "float16"}
+_DTYPE_IDS = {v: k for k, v in _DTYPES.items()}
+
+
+def _np_dtype(name: str):
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def pack_block(k: np.ndarray, v: np.ndarray) -> bytes:
+    """k/v: [L, Hkv, bs, Dh] arrays (any supported dtype)."""
+    name = {"bfloat16": "bfloat16"}.get(str(k.dtype), str(k.dtype))
+    header = struct.pack(
+        "<4sB4I", _MAGIC, _DTYPE_IDS[name],
+        k.shape[0], k.shape[1], k.shape[2], k.shape[3],
+    )
+    return header + k.tobytes() + v.tobytes()
+
+
+def unpack_block(blob: bytes) -> Tuple[np.ndarray, np.ndarray]:
+    magic, dt, nl, hkv, bs, dh = struct.unpack_from("<4sB4I", blob)
+    if magic != _MAGIC:
+        raise ValueError("bad KV block magic")
+    dtype = _np_dtype(_DTYPES[dt])
+    off = struct.calcsize("<4sB4I")
+    n = nl * hkv * bs * dh
+    nbytes = n * dtype.itemsize
+    k = np.frombuffer(blob, dtype, count=n, offset=off).reshape(nl, hkv, bs, dh)
+    v = np.frombuffer(blob, dtype, count=n, offset=off + nbytes).reshape(
+        nl, hkv, bs, dh
+    )
+    return k, v
+
+
+def get_serde(name: str):
+    if name == "naive":
+        return pack_block, unpack_block
+    raise ValueError(f"Unknown KV serde: {name!r} (supported: naive)")

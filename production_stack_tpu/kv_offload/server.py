@@ -1,0 +1,134 @@
+"""Cache-server entry point: ``python -m production_stack_tpu.kv_offload.server``.
+
+Launches the native C++ server (native/kv_server.cpp) when its binary is
+available — the reference's `lmcache_experimental_server` pod equivalent
+(reference helm/templates/deployment-cache-server.yaml) — and otherwise
+serves the same wire protocol in pure Python (asyncio), so tests and
+binary-less environments still work.
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import shutil
+import struct
+import subprocess
+import sys
+from collections import OrderedDict
+
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+STATUS_OK, STATUS_MISSING, STATUS_ERROR = 0, 1, 2
+
+
+def find_native_binary() -> str:
+    candidates = [
+        os.path.join(os.path.dirname(__file__), "..", "..", "native",
+                     "build", "kv_server"),
+        shutil.which("kv_server") or "",
+    ]
+    for c in candidates:
+        c = os.path.abspath(c)
+        if c and os.path.isfile(c) and os.access(c, os.X_OK):
+            return c
+    return ""
+
+
+class PyKVServer:
+    """Pure-Python fallback implementing the same protocol + LRU bound."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max_bytes
+        self._data: "OrderedDict[bytes, bytes]" = OrderedDict()
+        self._bytes = 0
+        self.hits = self.misses = self.stores = self.evictions = 0
+
+    async def handle(self, reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                op = await reader.readexactly(1)
+                (klen,) = struct.unpack("<I", await reader.readexactly(4))
+                key = await reader.readexactly(klen) if klen else b""
+                (vlen,) = struct.unpack("<Q", await reader.readexactly(8))
+                val = await reader.readexactly(vlen) if vlen else b""
+                status, payload = self._dispatch(op, key, val)
+                writer.write(
+                    bytes([status]) + struct.pack("<Q", len(payload)) + payload
+                )
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+
+    def _dispatch(self, op: bytes, key: bytes, val: bytes):
+        if op == b"P":
+            old = self._data.pop(key, None)
+            if old is not None:
+                self._bytes -= len(old)
+            self._data[key] = val
+            self._bytes += len(val)
+            self.stores += 1
+            while self._bytes > self.max_bytes and self._data:
+                _, ev = self._data.popitem(last=False)
+                self._bytes -= len(ev)
+                self.evictions += 1
+            return STATUS_OK, b""
+        if op == b"G":
+            blob = self._data.get(key)
+            if blob is None:
+                self.misses += 1
+                return STATUS_MISSING, b""
+            self._data.move_to_end(key)
+            self.hits += 1
+            return STATUS_OK, blob
+        if op == b"E":
+            return (STATUS_OK if key in self._data else STATUS_MISSING), b""
+        if op == b"T":
+            return STATUS_OK, json.dumps({
+                "entries": len(self._data), "bytes": self._bytes,
+                "max_bytes": self.max_bytes, "hits": self.hits,
+                "misses": self.misses, "stores": self.stores,
+                "evictions": self.evictions, "impl": "python",
+            }).encode()
+        return STATUS_ERROR, b""
+
+
+async def serve_python(host: str, port: int, max_bytes: int) -> None:
+    server = PyKVServer(max_bytes)
+    srv = await asyncio.start_server(server.handle, host, port)
+    logger.info("Python kv_server listening on %s:%d (max %d bytes)",
+                host, port, max_bytes)
+    async with srv:
+        await srv.serve_forever()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="Shared KV cache server")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8200)
+    ap.add_argument("--max-bytes", type=int, default=32 << 30)
+    ap.add_argument("--force-python", action="store_true",
+                    help="skip the native binary even if present")
+    args = ap.parse_args(argv)
+
+    if not args.force_python:
+        binary = find_native_binary()
+        if binary:
+            logger.info("Exec native kv_server: %s", binary)
+            return subprocess.call([
+                binary, "--port", str(args.port),
+                "--max-bytes", str(args.max_bytes),
+            ])
+        logger.warning("Native kv_server binary not found "
+                       "(build with `make -C native`); using Python server")
+    asyncio.run(serve_python(args.host, args.port, args.max_bytes))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
