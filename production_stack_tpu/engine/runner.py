@@ -142,6 +142,16 @@ class ModelRunner:
         self.kv_k = jax.device_put(jnp.zeros(kv_shape, self.dtype), kv_sh)
         self.kv_v = jax.device_put(jnp.zeros(kv_shape, self.dtype), kv_sh)
 
+        from production_stack_tpu.parallel.mesh import AXIS_SP
+
+        if mesh.shape[AXIS_SP] > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            # Prefill activations shard the token axis over sp (see
+            # models/llama.py forward docstring).
+            self._act_sharding = NamedSharding(mesh, P(None, AXIS_SP, None))
+        else:
+            self._act_sharding = None
         self._step = jax.jit(self._step_impl, donate_argnums=(1, 2))
         self._decode_multi = jax.jit(
             self._decode_multi_impl,
@@ -181,6 +191,7 @@ class ModelRunner:
             params, self.model_config, token_ids, positions, kv_k, kv_v,
             slot_mapping, block_tables, kv_lens,
             block_size=self.config.block_size, attn_impl=self.attn_impl,
+            act_sharding=self._act_sharding,
         )
         b = hidden.shape[0]
         last_hidden = hidden[jnp.arange(b), logit_idx]          # [B, D]

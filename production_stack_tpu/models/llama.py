@@ -140,9 +140,20 @@ def forward(
     *,
     block_size: int,
     attn_impl: str = "xla",
+    act_sharding=None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Returns (hidden [B,T,D], kv_k, kv_v) with current-chunk KV written."""
+    """Returns (hidden [B,T,D], kv_k, kv_v) with current-chunk KV written.
+
+    ``act_sharding``: optional NamedSharding P(None, "sp", None) — prefill
+    chunks shard the TOKEN axis over the sequence-parallel mesh axis so the
+    projection/MLP matmuls distribute over sp; GSPMD inserts the collectives
+    that keep the (sp-replicated) KV pool consistent. The standalone ring
+    kernel lives in production_stack_tpu/ops/ring_attention.py.
+    """
     hidden = params["embed"][token_ids].astype(kv_k.dtype)
+    if act_sharding is not None and hidden.shape[1] > 1 and \
+            hidden.shape[1] % act_sharding.mesh.shape["sp"] == 0:
+        hidden = jax.lax.with_sharding_constraint(hidden, act_sharding)
     cos, sin = _rope_cos_sin(positions, cfg.head_dim_, cfg.rope_theta)
 
     def scan_fn(h_carry, xs):

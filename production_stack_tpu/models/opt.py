@@ -78,10 +78,14 @@ def _layer_body(cfg, block_size, attn_impl, hidden, lp,
 
 
 def forward(params, cfg, token_ids, positions, kv_k, kv_v,
-            slot_mapping, block_tables, kv_lens, *, block_size, attn_impl="xla"):
+            slot_mapping, block_tables, kv_lens, *, block_size,
+            attn_impl="xla", act_sharding=None):
     hidden = (
         params["embed"][token_ids] + params["pos_embed"][positions + _OPT_POS_OFFSET]
     ).astype(kv_k.dtype)
+    if act_sharding is not None and hidden.shape[1] > 1 and \
+            hidden.shape[1] % act_sharding.mesh.shape["sp"] == 0:
+        hidden = jax.lax.with_sharding_constraint(hidden, act_sharding)
 
     def scan_fn(h_carry, xs):
         lp, kp, vp = xs
