@@ -24,12 +24,19 @@ class EngineConfig:
     enable_prefix_caching: bool = True
     # --- scheduler ---
     max_num_seqs: int = 64
-    max_num_batched_tokens: int = 1024      # prefill chunk token budget
+    max_num_batched_tokens: int = 4096      # prefill dispatch token budget
+    max_prefill_seqs: int = 8               # rows per batched prefill dispatch
     # Decode steps fused into ONE device dispatch (lax.scan inside the jit):
     # K*B tokens per host round-trip instead of B. Host-side stop conditions
     # (EOS, stop strings, aborts) are applied after the fetch, so up to K-1
-    # tokens per sequence are speculatively computed and discarded.
-    num_decode_steps: int = 8
+    # tokens per sequence are speculatively computed and discarded. Each
+    # dispatch pays ~10 ms of host<->device RTT on the target deployment, so
+    # K trades streaming granularity against that fixed cost.
+    num_decode_steps: int = 32
+    # AOT-compile the primary decode/prefill shape families at startup
+    # (ModelRunner.warmup). Off by default so tests and short-lived engines
+    # don't pay it; the API server turns it on.
+    enable_warmup: bool = False
     # --- parallelism (jax.sharding over the TPU slice mesh) ---
     tensor_parallel_size: int = 1
     sequence_parallel_size: int = 1         # ring-attention axis for long prefill

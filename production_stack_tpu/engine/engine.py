@@ -116,6 +116,9 @@ class ServingEngine:
     async def start(self) -> None:
         if self._running:
             return
+        if self.config.enable_warmup:
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(None, self.runner.warmup)
         self._running = True
         self._loop_task = asyncio.create_task(self._run_loop())
         logger.info(
@@ -248,7 +251,11 @@ class ServingEngine:
         delta = st.detok.step(seq.output_token_ids, flush=finished)
         st.text += delta
         stops = seq.sampling.stop
-        if stops and delta and not finished:
+        if stops and delta:
+            # Scan even when the request already finished (length/EOS): the
+            # detokenizer may hold back bytes until the final flush, so a stop
+            # match can first become visible in the finishing delta — OpenAI
+            # semantics still require truncating there and reporting "stop".
             max_stop = max(len(s) for s in stops)
             start = max(0, len(st.text) - len(delta) - max_stop)
             idx = -1
@@ -261,17 +268,26 @@ class ServingEngine:
                 # Drop sampled-past-the-stop tokens (the fused K-step decode
                 # can overshoot a stop match by up to K-1 tokens) so token_ids
                 # and usage reflect the delivered text, not the speculation.
+                # Binary search for the smallest kept prefix: decode length is
+                # monotone in token count, and this runs at most once per
+                # request, so the cost is O(n log n) rather than the naive
+                # per-token re-decode's O(n^2).
                 toks = seq.output_token_ids
-                m = 0
-                while m < len(toks) and len(
-                    self.tokenizer.decode(toks[:m])
-                ) < idx:
-                    m += 1
-                self.generation_tokens_total -= len(toks) - m
-                seq.output_token_ids = toks[:m]
-                self.scheduler.finish(
-                    seq.request_id, SequenceStatus.FINISHED_STOPPED
-                )
+                lo, hi = 0, len(toks)
+                while lo < hi:
+                    mid = (lo + hi) // 2
+                    if len(self.tokenizer.decode(toks[:mid])) < idx:
+                        lo = mid + 1
+                    else:
+                        hi = mid
+                self.generation_tokens_total -= len(toks) - lo
+                seq.output_token_ids = toks[:lo]
+                if finished:
+                    seq.status = SequenceStatus.FINISHED_STOPPED
+                else:
+                    self.scheduler.finish(
+                        seq.request_id, SequenceStatus.FINISHED_STOPPED
+                    )
                 finished = True
         hold = 0 if finished or not stops else max(len(s) for s in stops) - 1
         emit_upto = max(len(st.text) - hold, st.sent)

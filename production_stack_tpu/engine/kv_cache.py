@@ -60,7 +60,14 @@ class BlockPoolManager:
     # ------------------------------------------------------------- accounting
     @property
     def num_free_blocks(self) -> int:
-        return len(self._free) + len(self._evictable)
+        # Spill-pinned evictable blocks are NOT reclaimable (_pop_free_block
+        # skips them), so they must not be counted either — otherwise
+        # can_allocate() overpromises and allocate_blocks() comes up short
+        # when the free list is empty and every evictable block is pinned.
+        pinned_evictable = sum(
+            1 for b in self._spill_pinned if b in self._evictable
+        )
+        return len(self._free) + len(self._evictable) - pinned_evictable
 
     @property
     def num_used_blocks(self) -> int:
@@ -105,7 +112,12 @@ class BlockPoolManager:
         out = []
         for _ in range(n):
             blk = self._pop_free_block()
-            assert blk is not None
+            if blk is None:
+                # Defensive: roll back the partial allocation rather than
+                # crash the engine loop if accounting and reclaimability ever
+                # disagree (e.g. a spill pin landing mid-allocation).
+                self.free_blocks(out)
+                return None
             self._ref[blk] = 1
             out.append(blk)
         return out

@@ -1,17 +1,29 @@
-"""ModelRunner: owns device state and the jitted serving step.
+"""ModelRunner: owns device state and the jitted serving steps.
 
-XLA discipline (the performance-critical part of the design):
-  * ONE step function serves prefill chunks and decode batches; it is traced
-    per (batch_bucket, token_bucket, blocktable_bucket) shape family only.
-    Buckets are powers of two, so the compile-cache cardinality is
-    O(log(max_num_seqs) * log(max_tokens) * log(max_blocks)).
-  * KV pools are donated every step — XLA updates them in place in HBM.
-  * Sampling runs inside the same jit: exactly one [B] int32 device->host
-    transfer per engine step.
+XLA discipline (the performance-critical part of the design — every item here
+was profiled on a v5e in round 1/2):
+  * The paged KV pool is gathered into a contiguous per-sequence WINDOW once
+    per dispatch (ops/attention.py:gather_window) and new KV is scattered back
+    once at the end. Per-layer gathers/scatters against the pool cost ~7 ms
+    per decode step (XLA gathers run at ~15% of HBM bandwidth; pool xs/ys in
+    the layer scan copy the pool every layer); the hoisted form amortizes one
+    gather over num_decode_steps * num_layers uses.
+  * A fused decode dispatch runs K steps in one lax.scan: tokens produced
+    mid-dispatch live in a small ring buffer [L, Hkv, B, K, Dh] that the
+    attention reads alongside the window, so only ONE [K, B] device->host
+    fetch happens per K*B tokens.
+  * ALL small host inputs are packed into ONE int32 buffer per dispatch
+    (floats bitcast): each host->device transfer costs ~10 ms of tunnel RTT
+    on the target deployment, so per-dispatch transfer count is 1 up + 1 down.
+    Slot mappings, positions, per-step PRNG seeds, and window indices are
+    derived ON DEVICE from block tables + scalars.
+  * Step functions are traced per (batch_bucket, token_bucket,
+    blocktable_bucket) shape family only; buckets are powers of two.
+  * Sampling runs inside the same jit (sort-free: engine/sampling.py).
 """
 
 import functools
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -22,11 +34,15 @@ from production_stack_tpu.engine.sampling import sample_tokens
 from production_stack_tpu.engine.scheduler import ScheduledBatch, Sequence
 from production_stack_tpu.models import get_model_fns
 from production_stack_tpu.models.config import ModelConfig
+from production_stack_tpu.ops.attention import gather_window
 from production_stack_tpu.parallel import kv_pool_sharding, param_shardings
 from production_stack_tpu.parallel.mesh import Mesh
 from production_stack_tpu.utils import cdiv, init_logger
 
 logger = init_logger(__name__)
+
+_SEED_MULT = np.uint32(1000003)
+_POS_SENTINEL = np.int32(2**30)  # ring_pos value for not-yet-written entries
 
 
 def _bucket(n: int, lo: int, hi: int) -> int:
@@ -42,16 +58,21 @@ def _dtype(name: str):
             "float16": jnp.float16}[name]
 
 
-def _token_seed(seq: Sequence, gen_index: int) -> np.uint32:
-    """Seed for the token at generation index `gen_index` of `seq`.
-
-    Per-sequence-deterministic: the same request produces the same tokens
-    regardless of batching, scan length, or prefill/decode path — both
-    dispatch paths MUST derive seeds through this one helper.
-    """
+def _seed_base(seq: Sequence) -> np.uint32:
     sp = seq.sampling
     base = sp.seed if sp.seed is not None else (hash(seq.request_id) & 0x7FFFFFFF)
-    return np.uint32((base * 1000003 + gen_index) & 0xFFFFFFFF)
+    return np.uint32(base & 0xFFFFFFFF)
+
+
+def _token_seed(seq: Sequence, gen_index: int) -> np.uint32:
+    """Seed for the token at generation index ``gen_index`` of ``seq``.
+
+    Per-sequence-deterministic: the same request produces the same tokens
+    regardless of batching, scan length, or prefill/decode path. The device
+    computes the same arithmetic in uint32 (see _derive_seeds)."""
+    return np.uint32(
+        (int(_seed_base(seq)) * int(_SEED_MULT) + gen_index) & 0xFFFFFFFF
+    )
 
 
 _cache_configured = False
@@ -87,19 +108,9 @@ class ModelRunner:
         self.config = config
         self.model_config = model_config
         self.mesh = mesh
-        self.attn_impl = config.resolved_attn_impl()
-        from production_stack_tpu.parallel.mesh import AXIS_TP
-
-        if self.attn_impl == "pallas" and mesh.shape[AXIS_TP] > 1:
-            # The pallas decode kernel has no GSPMD partitioning rule yet;
-            # under tensor parallelism GSPMD would replicate (all-gather) the
-            # head-sharded KV pools -> instant HBM OOM. The XLA einsum path
-            # propagates the head sharding correctly.
-            logger.warning(
-                "attn_impl=pallas is single-chip only for now; using XLA "
-                "paged attention under tp=%d", mesh.shape[AXIS_TP],
-            )
-            self.attn_impl = "xla"
+        self.attn_impl = "window"  # see module docstring; config.attn_impl is
+        # honored only as "xla"-family — the standalone pallas kernel remains
+        # available for direct use (ops/pallas/paged_attention.py).
         self.dtype = _dtype(config.dtype)
         if config.compilation_cache_dir:
             _setup_compilation_cache(config.compilation_cache_dir)
@@ -132,8 +143,6 @@ class ModelRunner:
         self.num_kv_blocks = num_kv_blocks or config.num_kv_blocks or \
             self._derive_num_blocks()
         num_slots = self.num_kv_blocks * config.block_size
-        # Head-major pools: the Pallas decode kernel DMAs [Hkv, bs, Dh] pages
-        # straight into compute layout, no per-page relayout.
         kv_shape = (
             model_config.num_layers, model_config.num_kv_heads,
             num_slots, model_config.head_dim_,
@@ -152,11 +161,15 @@ class ModelRunner:
             self._act_sharding = NamedSharding(mesh, P(None, AXIS_SP, None))
         else:
             self._act_sharding = None
-        self._step = jax.jit(self._step_impl, donate_argnums=(1, 2))
-        self._decode_multi = jax.jit(
-            self._decode_multi_impl,
-            static_argnames=("num_steps",),
-            donate_argnums=(1, 2),
+        self._decode = jax.jit(
+            self._decode_impl,
+            static_argnames=("b", "mb", "num_steps"),
+            donate_argnums=(2, 3),
+        )
+        self._prefill = jax.jit(
+            self._prefill_impl,
+            static_argnames=("b", "t", "mb", "has_window"),
+            donate_argnums=(2, 3),
         )
 
     # ------------------------------------------------------------------ sizing
@@ -176,104 +189,140 @@ class ModelRunner:
             pass
         if free_bytes is None:
             free_bytes = 2 << 30  # conservative default when unprobeable
-        n = int(free_bytes * cfg.hbm_utilization) // bytes_per_block
-        n = max(2, min(n, cdiv(cfg.max_model_len, cfg.block_size)
-                       * cfg.max_num_seqs + 1))
+        # The decode window is a gathered copy of the live KV (up to
+        # max_num_seqs * max_blocks_per_seq blocks), so budget for pool +
+        # window rather than pool alone.
+        n = int(free_bytes * cfg.hbm_utilization) // (2 * bytes_per_block)
+        n = max(2, min(n, cfg.max_blocks_per_seq * cfg.max_num_seqs + 1))
         logger.info("KV pool: %d blocks x %d tokens (%.1f MiB)",
                     n, cfg.block_size, n * bytes_per_block / (1 << 20))
         return n
 
-    # ------------------------------------------------------------------- step
-    def _step_impl(self, params, kv_k, kv_v, token_ids, positions,
-                   slot_mapping, block_tables, kv_lens, logit_idx,
-                   temps, top_k, top_p, seeds):
-        hidden, kv_k, kv_v = self._forward(
-            params, self.model_config, token_ids, positions, kv_k, kv_v,
-            slot_mapping, block_tables, kv_lens,
-            block_size=self.config.block_size, attn_impl=self.attn_impl,
-            act_sharding=self._act_sharding,
-        )
-        b = hidden.shape[0]
-        last_hidden = hidden[jnp.arange(b), logit_idx]          # [B, D]
-        logits = self._logits_fn(params, self.model_config, last_hidden)
-        next_tokens = sample_tokens(logits, temps, top_k, top_p, seeds)
-        return next_tokens, kv_k, kv_v
+    # --------------------------------------------------------- device helpers
+    def _derive_seeds(self, seed_base, gen0, j):
+        """uint32 seed per row for generation index gen0+j; must match
+        _token_seed exactly (same wrap-around arithmetic)."""
+        return (
+            seed_base * _SEED_MULT
+            + (gen0 + j.astype(np.uint32))
+        ).astype(jnp.uint32)
 
-    def _decode_multi_impl(self, params, kv_k, kv_v, tokens0, pos0,
-                           block_tables, slot_steps, kv_len0, temps, top_k,
-                           top_p, seed_steps, *, num_steps: int):
-        """K fused decode steps: lax.scan feeds each step's sampled token into
-        the next forward, so only ONE [K, B] host fetch happens per dispatch
-        (the per-step device->host sync is the serving bottleneck, not FLOPs).
+    # ------------------------------------------------------------------ decode
+    def _decode_impl(self, params, packed, kv_k, kv_v, *, b: int, mb: int,
+                     num_steps: int):
+        """One fused K-step decode dispatch.
 
-        Rows whose per-seq budget < num_steps have their excess KV writes
-        routed to the null block by slot_steps; their excess sampled tokens
-        are discarded host-side.
+        packed: int32[b*(8+mb)] host buffer laid out as 8 per-row scalars
+        (tokens0, pos0, budget, seed_base, gen0, temps, top_k, top_p — floats
+        bitcast) followed by the [b, mb] block tables. Everything else is
+        derived here, on device.
         """
-        max_len = self.config.max_model_len
+        cfg = self.config
+        bs = cfg.block_size
+        mc = self.model_config
+        scalars = packed[: 8 * b].reshape(8, b)
+        tokens0 = scalars[0]
+        pos0 = scalars[1]
+        budget = scalars[2]
+        seed_base = jax.lax.bitcast_convert_type(scalars[3], jnp.uint32)
+        gen0 = jax.lax.bitcast_convert_type(scalars[4], jnp.uint32)
+        temps = jax.lax.bitcast_convert_type(scalars[5], jnp.float32)
+        top_k = scalars[6]
+        top_p = jax.lax.bitcast_convert_type(scalars[7], jnp.float32)
+        block_tables = packed[8 * b:].reshape(b, mb)
+
+        # Per-step write slots [K, b] (0 = reserved null block for rows whose
+        # budget ran out) and per-step seeds [K, b].
+        k_iota = jnp.arange(num_steps, dtype=jnp.int32)
+        p = pos0[None, :] + k_iota[:, None]                     # [K, b]
+        blk_idx = jnp.clip(p // bs, 0, mb - 1)
+        blk = jnp.take_along_axis(
+            block_tables, blk_idx.T, axis=1
+        ).T                                                      # [K, b]
+        valid = k_iota[:, None] < budget[None, :]
+        slot_steps = jnp.where(valid, blk * bs + p % bs, 0)
+        seed_steps = self._derive_seeds(
+            seed_base[None, :], gen0[None, :], k_iota[:, None]
+        )
+
+        win_k, win_v = gather_window(kv_k, kv_v, block_tables, bs)
+        win_len = pos0                                           # [b]
+
+        nl, hkv, dh = mc.num_layers, mc.num_kv_heads, mc.head_dim_
+        ring_k0 = jnp.zeros((nl, hkv, b, num_steps, dh), self.dtype)
+        ring_v0 = jnp.zeros((nl, hkv, b, num_steps, dh), self.dtype)
+        ring_pos0 = jnp.full((b, num_steps), _POS_SENTINEL, jnp.int32)
+        ones = jnp.ones((b,), jnp.int32)
+        max_len = cfg.max_model_len
 
         def body(carry, xs):
-            kv_k, kv_v, toks = carry
-            slot_j, seeds_j, j = xs
+            toks, ring_k, ring_v, ring_pos = carry
+            j, seeds_j = xs
             positions = jnp.minimum(pos0 + j, max_len - 1)[:, None]
-            kv_lens = jnp.minimum(kv_len0 + j, max_len)
-            hidden, kv_k, kv_v = self._forward(
-                params, self.model_config, toks[:, None], positions,
-                kv_k, kv_v, slot_j[:, None], block_tables, kv_lens,
-                block_size=self.config.block_size, attn_impl=self.attn_impl,
+            hidden, k_new, v_new = self._forward(
+                params, mc, toks[:, None], positions, ones,
+                win_k, win_v, win_len, ring_k, ring_v, ring_pos,
             )
-            logits = self._logits_fn(params, self.model_config, hidden[:, 0])
+            logits = self._logits_fn(params, mc, hidden[:, 0])
             nxt = sample_tokens(logits, temps, top_k, top_p, seeds_j)
-            return (kv_k, kv_v, nxt), nxt
+            # Append this step's KV (+ its position) to the ring at index j.
+            ring_k = jax.lax.dynamic_update_slice(
+                ring_k, k_new, (0, 0, 0, j, 0)
+            )
+            ring_v = jax.lax.dynamic_update_slice(
+                ring_v, v_new, (0, 0, 0, j, 0)
+            )
+            ring_pos = jax.lax.dynamic_update_slice(
+                ring_pos, positions, (0, j)
+            )
+            return (nxt.astype(jnp.int32), ring_k, ring_v, ring_pos), nxt
 
-        (kv_k, kv_v, _), toks_all = jax.lax.scan(
-            body, (kv_k, kv_v, tokens0),
-            (slot_steps, seed_steps, jnp.arange(num_steps, dtype=jnp.int32)),
+        (_, ring_k, ring_v, _), toks_all = jax.lax.scan(
+            body, (tokens0, ring_k0, ring_v0, ring_pos0),
+            (k_iota, seed_steps),
         )
-        return toks_all, kv_k, kv_v  # toks_all: [K, B]
+
+        # ONE scatter writes the whole dispatch's KV back to the paged pool.
+        flat_slots = slot_steps.reshape(-1)                       # [K*b]
+        k_flat = ring_k.transpose(0, 1, 3, 2, 4).reshape(
+            nl, hkv, num_steps * b, dh
+        )
+        v_flat = ring_v.transpose(0, 1, 3, 2, 4).reshape(
+            nl, hkv, num_steps * b, dh
+        )
+        kv_k = kv_k.at[:, :, flat_slots].set(k_flat)
+        kv_v = kv_v.at[:, :, flat_slots].set(v_flat)
+        return toks_all, kv_k, kv_v                               # [K, b]
 
     def _execute_decode(self, batch: ScheduledBatch) -> List[List[int]]:
         cfg = self.config
-        bs = cfg.block_size
         seqs = batch.seqs
         k = batch.num_steps
         b = _bucket(len(seqs), 1, max(1, cfg.max_num_seqs))
         mb = _bucket(max(len(s.block_ids) for s in seqs), 1,
                      max(1, cfg.max_blocks_per_seq))
 
-        tokens0 = np.zeros((b,), np.int32)
-        pos0 = np.zeros((b,), np.int32)
-        kv_len0 = np.ones((b,), np.int32)
-        block_tables = np.zeros((b, mb), np.int32)
-        slot_steps = np.zeros((k, b), np.int32)    # 0 -> null block
-        seed_steps = np.zeros((k, b), np.uint32)
-        temps = np.zeros((b,), np.float32)
-        top_k = np.full((b,), -1, np.int32)
-        top_p = np.ones((b,), np.float32)
-
+        packed = np.zeros((8 * b + b * mb,), np.int32)
+        sc = packed[: 8 * b].reshape(8, b)
+        bt = packed[8 * b:].reshape(b, mb)
+        f32 = sc.view(np.float32)
+        u32 = sc.view(np.uint32)
         for i, s in enumerate(seqs):
             pos = s.num_computed_tokens
-            tokens0[i] = s.all_token_ids[pos]
-            pos0[i] = pos
-            kv_len0[i] = pos + 1
-            block_tables[i, :len(s.block_ids)] = s.block_ids
-            for j in range(batch.decode_steps[i]):
-                p = pos + j
-                slot_steps[j, i] = s.block_ids[p // bs] * bs + p % bs
+            sc[0, i] = s.all_token_ids[pos]
+            sc[1, i] = pos
+            sc[2, i] = batch.decode_steps[i]
+            u32[3, i] = _seed_base(s)
+            u32[4, i] = len(s.output_token_ids)
             sp = s.sampling
-            temps[i] = sp.temperature
-            top_k[i] = sp.top_k
-            top_p[i] = sp.top_p
-            n_out = len(s.output_token_ids)
-            for j in range(k):
-                seed_steps[j, i] = _token_seed(s, n_out + j)
+            f32[5, i] = sp.temperature
+            sc[6, i] = sp.top_k
+            f32[7, i] = sp.top_p
+            bt[i, :len(s.block_ids)] = s.block_ids
 
-        toks_all, self.kv_k, self.kv_v = self._decode_multi(
-            self.params, self.kv_k, self.kv_v,
-            jnp.asarray(tokens0), jnp.asarray(pos0),
-            jnp.asarray(block_tables), jnp.asarray(slot_steps),
-            jnp.asarray(kv_len0), jnp.asarray(temps), jnp.asarray(top_k),
-            jnp.asarray(top_p), jnp.asarray(seed_steps), num_steps=k,
+        toks_all, self.kv_k, self.kv_v = self._decode(
+            self.params, jnp.asarray(packed), self.kv_k, self.kv_v,
+            b=b, mb=mb, num_steps=k,
         )
         out = np.asarray(toks_all)  # ONE [K, B] fetch per K*B tokens
         return [
@@ -281,69 +330,113 @@ class ModelRunner:
             for i in range(len(seqs))
         ]
 
-    # ---------------------------------------------------------- batch assembly
+    # ----------------------------------------------------------------- prefill
+    def _prefill_impl(self, params, packed, kv_k, kv_v, *, b: int, t: int, mb: int,
+                      has_window: bool):
+        """One (multi-sequence) prefill chunk dispatch.
+
+        packed: int32[b*(8+mb) + b*t]: 8 per-row scalars (chunk_start,
+        chunk_len, seed_base, gen0, temps, top_k, top_p, pad), the [b, mb]
+        block tables, then the [b, t] chunk token ids. Positions and the KV
+        write slots are derived on device.
+        """
+        cfg = self.config
+        bs = cfg.block_size
+        mc = self.model_config
+        scalars = packed[: 8 * b].reshape(8, b)
+        chunk_start = scalars[0]
+        chunk_lens = scalars[1]
+        seed_base = jax.lax.bitcast_convert_type(scalars[2], jnp.uint32)
+        gen0 = jax.lax.bitcast_convert_type(scalars[3], jnp.uint32)
+        temps = jax.lax.bitcast_convert_type(scalars[4], jnp.float32)
+        top_k = scalars[5]
+        top_p = jax.lax.bitcast_convert_type(scalars[6], jnp.float32)
+        block_tables = packed[8 * b: 8 * b + b * mb].reshape(b, mb)
+        token_ids = packed[8 * b + b * mb:].reshape(b, t)
+
+        t_iota = jnp.arange(t, dtype=jnp.int32)
+        positions = jnp.minimum(
+            chunk_start[:, None] + t_iota[None, :], cfg.max_model_len - 1
+        )                                                        # [b, t]
+        in_chunk = t_iota[None, :] < chunk_lens[:, None]
+        blk_idx = jnp.clip(positions // bs, 0, mb - 1)
+        blk = jnp.take_along_axis(block_tables, blk_idx, axis=1)
+        slot_mapping = jnp.where(in_chunk, blk * bs + positions % bs, 0)
+
+        if has_window:
+            win_k, win_v = gather_window(kv_k, kv_v, block_tables, bs)
+            win_len = chunk_start
+        else:
+            win_k = win_v = win_len = None
+
+        hidden, k_new, v_new = self._forward(
+            params, mc, token_ids, positions, chunk_lens,
+            win_k, win_v, win_len,
+            act_sharding=self._act_sharding,
+        )
+        logit_idx = jnp.maximum(chunk_lens - 1, 0)
+        last_hidden = hidden[jnp.arange(b), logit_idx]            # [b, D]
+        logits = self._logits_fn(params, mc, last_hidden)
+        seeds = self._derive_seeds(seed_base, gen0, jnp.uint32(0))
+        next_tokens = sample_tokens(logits, temps, top_k, top_p, seeds)
+
+        nl, hkv, dh = mc.num_layers, mc.num_kv_heads, mc.head_dim_
+        flat_slots = slot_mapping.reshape(-1)                     # [b*t]
+        kv_k = kv_k.at[:, :, flat_slots].set(k_new.reshape(nl, hkv, b * t, dh))
+        kv_v = kv_v.at[:, :, flat_slots].set(v_new.reshape(nl, hkv, b * t, dh))
+        return next_tokens, kv_k, kv_v
+
+    def _execute_prefill(self, batch: ScheduledBatch) -> List[List[int]]:
+        cfg = self.config
+        seqs = batch.seqs
+        n = len(seqs)
+        b = _bucket(n, 1, max(1, cfg.max_num_seqs))
+        t = _bucket(max(batch.chunk_lens), 16,
+                    max(16, cfg.max_num_batched_tokens))
+        mb = _bucket(max(len(s.block_ids) for s in seqs), 1,
+                     max(1, cfg.max_blocks_per_seq))
+        has_window = any(st > 0 for st in batch.chunk_starts)
+
+        packed = np.zeros((8 * b + b * mb + b * t,), np.int32)
+        sc = packed[: 8 * b].reshape(8, b)
+        bt = packed[8 * b: 8 * b + b * mb].reshape(b, mb)
+        toks = packed[8 * b + b * mb:].reshape(b, t)
+        f32 = sc.view(np.float32)
+        u32 = sc.view(np.uint32)
+        for i, s in enumerate(seqs):
+            start, ln = batch.chunk_starts[i], batch.chunk_lens[i]
+            sc[0, i] = start
+            sc[1, i] = ln
+            u32[2, i] = _seed_base(s)
+            u32[3, i] = len(s.output_token_ids)
+            sp = s.sampling
+            f32[4, i] = sp.temperature
+            sc[5, i] = sp.top_k
+            f32[6, i] = sp.top_p
+            bt[i, :len(s.block_ids)] = s.block_ids
+            toks[i, :ln] = s.all_token_ids[start:start + ln]
+
+        next_tokens, self.kv_k, self.kv_v = self._prefill(
+            self.params, jnp.asarray(packed), self.kv_k, self.kv_v,
+            b=b, t=t, mb=mb, has_window=has_window,
+        )
+        finals = [
+            batch.chunk_starts[i] + batch.chunk_lens[i] >= seqs[i].num_tokens
+            for i in range(n)
+        ]
+        if not any(finals):
+            # No row finished its prompt: skip the blocking fetch entirely.
+            return [[] for _ in range(n)]
+        out = np.asarray(next_tokens)
+        return [[int(out[i])] if finals[i] else [] for i in range(n)]
+
+    # ---------------------------------------------------------------- execute
     def execute(self, batch: ScheduledBatch, step_counter: int) -> List[List[int]]:
         """Run one dispatch; returns per-sequence NEW token lists (empty for
         a non-final prefill chunk, whose sampled token is never fetched)."""
         if batch.kind == "decode":
             return self._execute_decode(batch)
-        cfg = self.config
-        bs = cfg.block_size
-        seq = batch.seqs[0]
-        start, n = batch.chunk_starts[0], batch.chunk_lens[0]
-        t = _bucket(n, 8, max(8, cfg.max_num_batched_tokens))
-        b = 1
-        tokens_list = [seq.all_token_ids[start:start + n]]
-        pos_list = [list(range(start, start + n))]
-        seqs = [seq]
-        final_chunk = start + n >= seq.num_tokens
-
-        # Prefill always uses the FULL block-table bucket: prefill is
-        # compute-bound, so the extra gather width costs little, and it keeps
-        # the prefill compile-cache keyed on t alone (decode, which is
-        # gather-bound, keeps per-size mb buckets).
-        mb = _bucket(cfg.max_blocks_per_seq, 1, max(1, cfg.max_blocks_per_seq))
-
-        token_ids = np.zeros((b, t), np.int32)
-        positions = np.zeros((b, t), np.int32)
-        slot_mapping = np.zeros((b, t), np.int32)   # 0 -> null block
-        block_tables = np.zeros((b, mb), np.int32)
-        kv_lens = np.zeros((b,), np.int32)
-        logit_idx = np.zeros((b,), np.int32)
-        temps = np.zeros((b,), np.float32)
-        top_k = np.full((b,), -1, np.int32)
-        top_p = np.ones((b,), np.float32)
-        seeds = np.zeros((b,), np.uint32)
-
-        for i, s in enumerate(seqs):
-            toks, poss = tokens_list[i], pos_list[i]
-            n = len(toks)
-            token_ids[i, :n] = toks
-            positions[i, :n] = poss
-            for j, p in enumerate(poss):
-                slot_mapping[i, j] = s.block_ids[p // bs] * bs + p % bs
-            block_tables[i, :len(s.block_ids)] = s.block_ids
-            kv_lens[i] = poss[-1] + 1
-            logit_idx[i] = n - 1
-            sp = s.sampling
-            temps[i] = sp.temperature
-            top_k[i] = sp.top_k
-            top_p[i] = sp.top_p
-            seeds[i] = _token_seed(s, len(s.output_token_ids))
-
-        next_tokens, self.kv_k, self.kv_v = self._step(
-            self.params, self.kv_k, self.kv_v,
-            jnp.asarray(token_ids), jnp.asarray(positions),
-            jnp.asarray(slot_mapping), jnp.asarray(block_tables),
-            jnp.asarray(kv_lens), jnp.asarray(logit_idx),
-            jnp.asarray(temps), jnp.asarray(top_k), jnp.asarray(top_p),
-            jnp.asarray(seeds),
-        )
-        if not final_chunk:
-            # Mid-prompt chunk: the sampled token is meaningless — skip the
-            # blocking device->host fetch entirely.
-            return [[]]
-        return [[int(np.asarray(next_tokens)[0])]]
+        return self._execute_prefill(batch)
 
     # ------------------------------------------------------------ KV offload
     def _block_slots(self, block_ids: List[int], n_bucket: int) -> np.ndarray:
@@ -412,7 +505,42 @@ class ModelRunner:
 
     # ------------------------------------------------------------- maintenance
     def warmup(self) -> None:
-        """Pre-compile the most common shape families."""
-        # A decode at B=1 and a small prefill cover startup latency; further
-        # shapes compile on demand (cached thereafter).
-        pass
+        """AOT-compile the primary shape families before serving.
+
+        Uses jit.lower(...).compile() so no garbage executes and no donated
+        pool buffer is consumed. With the persistent compilation cache
+        (config.compilation_cache_dir) these compiles are paid once per
+        machine, not once per process.
+        """
+        cfg = self.config
+        b = _bucket(cfg.max_num_seqs, 1, max(1, cfg.max_num_seqs))
+        mb = _bucket(cfg.max_blocks_per_seq, 1, max(1, cfg.max_blocks_per_seq))
+        k = max(1, cfg.num_decode_steps)
+        kv_spec = jax.ShapeDtypeStruct(self.kv_k.shape, self.kv_k.dtype,
+                                       sharding=self.kv_k.sharding)
+
+        def spec(n):
+            return jax.ShapeDtypeStruct((n,), jnp.int32)
+
+        try:
+            params_spec = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                               sharding=a.sharding),
+                self.params,
+            )
+            self._decode.lower(
+                params_spec, spec(8 * b + b * mb), kv_spec, kv_spec,
+                b=b, mb=mb, num_steps=k,
+            ).compile()
+            t = _bucket(cfg.max_num_batched_tokens, 16,
+                        max(16, cfg.max_num_batched_tokens))
+            for has_window, pb in ((False, 1), (True, b)):
+                pb = _bucket(pb, 1, max(1, cfg.max_num_seqs))
+                self._prefill.lower(
+                    params_spec, spec(8 * pb + pb * mb + pb * t), kv_spec,
+                    kv_spec, b=pb, t=t, mb=mb, has_window=has_window,
+                ).compile()
+            logger.info("Warmup compiled: decode(b=%d,mb=%d,K=%d) + prefill "
+                        "families (t=%d)", b, mb, k, t)
+        except Exception:  # noqa: BLE001 — warmup must never kill serving
+            logger.exception("Warmup compilation failed (continuing)")

@@ -163,23 +163,34 @@ class Scheduler:
         return self._schedule_decode()
 
     def _try_schedule_prefill(self) -> Optional[ScheduledBatch]:
-        if not self.waiting or len(self.running) >= self.config.max_num_seqs:
+        """Admit up to max_prefill_seqs waiting prompts into ONE batched
+        prefill dispatch (concurrent arrivals must not serialize TTFT).
+
+        Mostly-FCFS: the first admissible sequence fixes the padded chunk
+        length T (its remaining prompt, capped by the token budget); further
+        sequences join with chunk = min(remaining, T) while rows * T stays
+        within the budget. Starved prompts (no blocks available) are skipped,
+        NOT preempted-for: preempting here admits ping-pong livelock; only
+        decode slot-appends preempt, which preserves FCFS progress.
+        """
+        cfg = self.config
+        max_rows = min(
+            cfg.max_prefill_seqs, cfg.max_num_seqs - len(self.running)
+        )
+        if not self.waiting or max_rows <= 0:
             return None
-        # Mostly-FCFS scan: prefer the queue head, but skip past prompts that
-        # cannot get blocks yet so a mid-prefill sequence deeper in the queue
-        # (which already holds blocks) can still make progress — otherwise a
-        # starved head could deadlock the pool.
-        seq = None
-        for cand in self.waiting:
-            if cand.block_ids:
-                seq = cand
+        budget = cfg.max_num_batched_tokens
+        seqs: List[Sequence] = []
+        starts: List[int] = []
+        lens: List[int] = []
+        chunk_cap = None
+        for cand in list(self.waiting):
+            if len(seqs) >= max_rows:
                 break
-            # Prefill NEVER preempts: a waiting prompt simply waits for blocks
-            # to free up. Preempting here admits ping-pong livelock (two
-            # starved prompts evicting each other); only decode slot-appends
-            # preempt, which preserves FCFS progress.
-            alloc = self.block_manager.allocate_prompt(cand.all_token_ids)
-            if alloc is not None:
+            if not cand.block_ids:
+                alloc = self.block_manager.allocate_prompt(cand.all_token_ids)
+                if alloc is None:
+                    continue  # starved; a later cand may already hold blocks
                 cand.block_ids, cand.num_cached_tokens = alloc
                 cand.num_computed_tokens = cand.num_cached_tokens
                 if self.offload is not None:
@@ -191,19 +202,29 @@ class Scheduler:
                     )
                     cand.num_computed_tokens += restored
                     cand.num_cached_tokens += restored
-                seq = cand
+            start = cand.num_computed_tokens
+            # NOTE: a preempted sequence re-prefills prompt+output together.
+            remaining = cand.num_tokens - start
+            if chunk_cap is None:
+                chunk_cap = min(remaining, budget)
+                # Rows are padded to a shared power-of-two token bucket; count
+                # the PADDED width against the budget so admission reflects
+                # actual device compute.
+                t_bucket = 16
+                while t_bucket < chunk_cap:
+                    t_bucket *= 2
+            elif (len(seqs) + 1) * t_bucket > budget:
                 break
-        if seq is None:
+            seqs.append(cand)
+            starts.append(start)
+            lens.append(min(remaining, chunk_cap))
+        if not seqs:
             return None
-        self.waiting.remove(seq)
-        start = seq.num_computed_tokens
-        # NOTE: a preempted sequence re-prefills prompt+output together.
-        chunk = min(
-            self.config.max_num_batched_tokens, seq.num_tokens - start
-        )
-        seq.status = SequenceStatus.RUNNING
+        for seq in seqs:
+            self.waiting.remove(seq)
+            seq.status = SequenceStatus.RUNNING
         return ScheduledBatch(
-            kind="prefill", seqs=[seq], chunk_starts=[start], chunk_lens=[chunk]
+            kind="prefill", seqs=seqs, chunk_starts=starts, chunk_lens=lens
         )
 
     def _schedule_decode(self) -> Optional[ScheduledBatch]:
@@ -291,21 +312,23 @@ class Scheduler:
         produced: List[Sequence] = []
         accepted = 0
         if batch.kind == "prefill":
-            seq = batch.seqs[0]
-            if seq.status.is_finished:
-                return produced, 0  # aborted while the step was in flight
-            seq.num_computed_tokens += batch.chunk_lens[0]
-            self._register_full_blocks(seq)
-            if seq.num_computed_tokens >= seq.num_tokens:
-                # Prefill complete: the sampled token is the next real token.
-                self._append_token(seq, token_lists[0][0])
-                accepted += 1
-                produced.append(seq)
-                self.running.append(seq)
-            else:
-                # More chunks to go; requeue at the front.
-                seq.status = SequenceStatus.WAITING
-                self.waiting.appendleft(seq)
+            requeue: List[Sequence] = []
+            for idx, seq in enumerate(batch.seqs):
+                if seq.status.is_finished:
+                    continue  # aborted while the step was in flight
+                seq.num_computed_tokens += batch.chunk_lens[idx]
+                self._register_full_blocks(seq)
+                if seq.num_computed_tokens >= seq.num_tokens:
+                    # Prefill complete: the sampled token is the next token.
+                    self._append_token(seq, token_lists[idx][0])
+                    accepted += 1
+                    produced.append(seq)
+                    self.running.append(seq)
+                else:
+                    # More chunks to go; requeue at the front (order kept).
+                    seq.status = SequenceStatus.WAITING
+                    requeue.append(seq)
+            self.waiting.extendleft(reversed(requeue))
         else:
             for seq, toks in zip(batch.seqs, token_lists):
                 if seq.status.is_finished:

@@ -82,9 +82,13 @@ class KVOffloadManager:
         with self._lock:
             if h in self._queued_hashes:
                 return
+            # Pin BEFORE the entry becomes poppable: the spill worker drains
+            # the queue under this same lock, so pinning outside it would let
+            # the worker spill + unpin before the pin lands, leaving the block
+            # pinned forever and excluded from eviction.
+            self.block_manager.pin_for_spill(blk)
             self._queued_hashes.add(h)
             self._queue.append((h, blk))
-        self.block_manager.pin_for_spill(blk)
 
     def _spill_worker(self) -> None:
         while self._running:

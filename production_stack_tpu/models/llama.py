@@ -6,21 +6,23 @@ TPU-first design notes:
     layer body instead of L inlined copies, which keeps XLA compile time flat
     in depth and produces identical per-layer fusions.
   * Activations are bfloat16; norms/softmax/rope math in float32.
-  * Attention reads/writes the paged KV pool (production_stack_tpu/ops/attention.py),
-    so prefill chunks and decode steps share this one forward function.
+  * The paged KV pool is NOT threaded through the layer scan. The runner
+    gathers the pool into a contiguous per-sequence window once per dispatch
+    (ops/attention.py:gather_window) and scatters the chunk's new KV back once
+    after the forward — scanning the pools as xs/ys cost a full pool copy per
+    layer (~2 ms/step on a v5e, profiled round 1).
 
 Weight layout matches HuggingFace LlamaForCausalLM for direct safetensors
-loading (production_stack_tpu/engine/weights.py).
+loading (production_stack_tpu/models/weights.py).
 """
 
-import functools
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from production_stack_tpu.models.config import ModelConfig
-from production_stack_tpu.ops.attention import paged_attention, write_kv_to_pool
+from production_stack_tpu.ops.attention import window_attention
 
 Params = Dict
 
@@ -85,18 +87,14 @@ def init_params(cfg: ModelConfig, rng: jax.Array, dtype=jnp.bfloat16) -> Params:
 
 def _layer_body(
     cfg: ModelConfig,
-    block_size: int,
-    attn_impl: str,
     hidden: jax.Array,        # [B, T, D]
     lp: Dict,                 # one layer's params (leading L axis sliced off)
-    k_pool: jax.Array,        # [Hkv, num_slots, Dh] (head-major)
-    v_pool: jax.Array,
     cos: jax.Array,
     sin: jax.Array,
-    slot_mapping: jax.Array,
-    block_tables: jax.Array,
-    kv_lens: jax.Array,
-    q_positions: jax.Array,
+    positions: jax.Array,
+    chunk_lens: jax.Array,
+    win_k, win_v, win_len,
+    ring_k, ring_v, ring_pos,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     b, t, d = hidden.shape
     h, hkv, dh = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
@@ -115,16 +113,16 @@ def _layer_body(
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
-    k_pool, v_pool = write_kv_to_pool(k_pool, v_pool, k, v, slot_mapping)
-    attn = paged_attention(
-        q, k_pool, v_pool, block_tables, kv_lens, q_positions,
-        block_size=block_size, impl=attn_impl,
+    attn = window_attention(
+        q, k, v, positions, chunk_lens,
+        win_k, win_v, win_len, ring_k, ring_v, ring_pos,
     )
     hidden = hidden + attn.reshape(b, t, h * dh) @ lp["wo"]
 
     x = rms_norm(hidden, lp["mlp_norm"], cfg.rms_norm_eps)
     mlp = (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
-    return hidden + mlp, k_pool, v_pool
+    # New KV in pool layout [Hkv, B, T, Dh] for the runner's single scatter.
+    return hidden + mlp, k.transpose(2, 0, 1, 3), v.transpose(2, 0, 1, 3)
 
 
 def forward(
@@ -132,43 +130,61 @@ def forward(
     cfg: ModelConfig,
     token_ids: jax.Array,     # [B, T]
     positions: jax.Array,     # [B, T]
-    kv_k: jax.Array,          # [L, Hkv, num_slots, Dh] (head-major)
-    kv_v: jax.Array,
-    slot_mapping: jax.Array,  # [B, T]
-    block_tables: jax.Array,  # [B, Mb]
-    kv_lens: jax.Array,       # [B]
+    chunk_lens: jax.Array,    # [B] valid tokens per row
+    win_k: Optional[jax.Array] = None,   # [L, Hkv, B, S, Dh] gathered window
+    win_v: Optional[jax.Array] = None,
+    win_len: Optional[jax.Array] = None,  # [B]
+    ring_k: Optional[jax.Array] = None,   # [L, Hkv, B, R, Dh]
+    ring_v: Optional[jax.Array] = None,
+    ring_pos: Optional[jax.Array] = None,  # [B, R]
     *,
-    block_size: int,
-    attn_impl: str = "xla",
     act_sharding=None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Returns (hidden [B,T,D], kv_k, kv_v) with current-chunk KV written.
+    """Returns (hidden [B,T,D], k_new [L,Hkv,B,T,Dh], v_new [L,Hkv,B,T,Dh]).
+
+    The caller owns the paged pool: it gathers the window before this call and
+    scatters (k_new, v_new) into the pool after (see engine/runner.py).
 
     ``act_sharding``: optional NamedSharding P(None, "sp", None) — prefill
     chunks shard the TOKEN axis over the sequence-parallel mesh axis so the
-    projection/MLP matmuls distribute over sp; GSPMD inserts the collectives
-    that keep the (sp-replicated) KV pool consistent. The standalone ring
-    kernel lives in production_stack_tpu/ops/ring_attention.py.
+    projection/MLP matmuls distribute over sp; GSPMD inserts the collectives.
+    The standalone ring kernel lives in production_stack_tpu/ops/ring_attention.py.
     """
-    hidden = params["embed"][token_ids].astype(kv_k.dtype)
+    hidden = params["embed"][token_ids]
+    hidden = hidden.astype(
+        win_k.dtype if win_k is not None else params["embed"].dtype
+    )
     if act_sharding is not None and hidden.shape[1] > 1 and \
             hidden.shape[1] % act_sharding.mesh.shape["sp"] == 0:
         hidden = jax.lax.with_sharding_constraint(hidden, act_sharding)
     cos, sin = _rope_cos_sin(positions, cfg.head_dim_, cfg.rope_theta)
 
-    def scan_fn(h_carry, xs):
-        lp, kp, vp = xs
-        h_out, kp, vp = _layer_body(
-            cfg, block_size, attn_impl, h_carry, lp, kp, vp,
-            cos, sin, slot_mapping, block_tables, kv_lens, positions,
-        )
-        return h_out, (kp, vp)
+    have_win = win_k is not None
+    have_ring = ring_k is not None
 
-    hidden, (kv_k, kv_v) = jax.lax.scan(
-        scan_fn, hidden, (params["layers"], kv_k, kv_v)
-    )
+    def scan_fn(h_carry, xs):
+        lp = xs[0]
+        i = 1
+        wk = wv = rk = rv = None
+        if have_win:
+            wk, wv = xs[i], xs[i + 1]
+            i += 2
+        if have_ring:
+            rk, rv = xs[i], xs[i + 1]
+        h_out, k_l, v_l = _layer_body(
+            cfg, h_carry, lp, cos, sin, positions, chunk_lens,
+            wk, wv, win_len, rk, rv, ring_pos,
+        )
+        return h_out, (k_l, v_l)
+
+    xs = (params["layers"],)
+    if have_win:
+        xs += (win_k, win_v)
+    if have_ring:
+        xs += (ring_k, ring_v)
+    hidden, (k_new, v_new) = jax.lax.scan(scan_fn, hidden, xs)
     hidden = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
-    return hidden, kv_k, kv_v
+    return hidden, k_new, v_new
 
 
 def compute_logits(params: Params, cfg: ModelConfig, hidden: jax.Array) -> jax.Array:

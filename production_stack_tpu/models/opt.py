@@ -1,19 +1,19 @@
 """OPT-family decoder (facebook/opt-125m etc.) — functional JAX.
 
 Kept deliberately close in structure to models/llama.py (stacked layers +
-lax.scan, paged KV pool attention) but with OPT's architecture: LayerNorm with
-bias, learned position embeddings with OPT's +2 offset quirk, GELU MLP, tied
-LM head. opt-125m is the reference's minimal parity config
-(values-01-minimal-example, BASELINE.json).
+lax.scan, window attention against the runner-gathered KV window) but with
+OPT's architecture: LayerNorm with bias, learned position embeddings with
+OPT's +2 offset quirk, ReLU MLP, tied LM head. opt-125m is the reference's
+minimal parity config (values-01-minimal-example, BASELINE.json).
 """
 
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from production_stack_tpu.models.config import ModelConfig
-from production_stack_tpu.ops.attention import paged_attention, write_kv_to_pool
+from production_stack_tpu.ops.attention import window_attention
 
 Params = Dict
 _OPT_POS_OFFSET = 2  # HF OPTLearnedPositionalEmbedding offset
@@ -53,8 +53,8 @@ def init_params(cfg: ModelConfig, rng: jax.Array, dtype=jnp.bfloat16) -> Params:
     }
 
 
-def _layer_body(cfg, block_size, attn_impl, hidden, lp,
-                k_pool, v_pool, slot_mapping, block_tables, kv_lens, q_positions):
+def _layer_body(cfg, hidden, lp, positions, chunk_lens,
+                win_k, win_v, win_len, ring_k, ring_v, ring_pos):
     b, t, d = hidden.shape
     h, dh = cfg.num_heads, cfg.head_dim_
 
@@ -63,10 +63,9 @@ def _layer_body(cfg, block_size, attn_impl, hidden, lp,
     k = (x @ lp["wk"] + lp["bk"]).reshape(b, t, h, dh)
     v = (x @ lp["wv"] + lp["bv"]).reshape(b, t, h, dh)
 
-    k_pool, v_pool = write_kv_to_pool(k_pool, v_pool, k, v, slot_mapping)
-    attn = paged_attention(
-        q, k_pool, v_pool, block_tables, kv_lens, q_positions,
-        block_size=block_size, impl=attn_impl,
+    attn = window_attention(
+        q, k, v, positions, chunk_lens,
+        win_k, win_v, win_len, ring_k, ring_v, ring_pos,
     )
     hidden = hidden + attn.reshape(b, t, h * dh) @ lp["wo"] + lp["bo"]
 
@@ -74,32 +73,61 @@ def _layer_body(cfg, block_size, attn_impl, hidden, lp,
     # OPT's activation is ReLU (HF OPTConfig.activation_function default,
     # used by facebook/opt-125m), not GELU.
     mlp = jax.nn.relu(x @ lp["fc1"] + lp["fc1_b"]) @ lp["fc2"] + lp["fc2_b"]
-    return hidden + mlp, k_pool, v_pool
+    return hidden + mlp, k.transpose(2, 0, 1, 3), v.transpose(2, 0, 1, 3)
 
 
-def forward(params, cfg, token_ids, positions, kv_k, kv_v,
-            slot_mapping, block_tables, kv_lens, *, block_size,
-            attn_impl="xla", act_sharding=None):
+def forward(
+    params: Params,
+    cfg: ModelConfig,
+    token_ids: jax.Array,
+    positions: jax.Array,
+    chunk_lens: jax.Array,
+    win_k: Optional[jax.Array] = None,
+    win_v: Optional[jax.Array] = None,
+    win_len: Optional[jax.Array] = None,
+    ring_k: Optional[jax.Array] = None,
+    ring_v: Optional[jax.Array] = None,
+    ring_pos: Optional[jax.Array] = None,
+    *,
+    act_sharding=None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Same contract as models/llama.py:forward (see its docstring)."""
     hidden = (
         params["embed"][token_ids] + params["pos_embed"][positions + _OPT_POS_OFFSET]
-    ).astype(kv_k.dtype)
+    )
+    hidden = hidden.astype(
+        win_k.dtype if win_k is not None else params["embed"].dtype
+    )
     if act_sharding is not None and hidden.shape[1] > 1 and \
             hidden.shape[1] % act_sharding.mesh.shape["sp"] == 0:
         hidden = jax.lax.with_sharding_constraint(hidden, act_sharding)
 
-    def scan_fn(h_carry, xs):
-        lp, kp, vp = xs
-        h_out, kp, vp = _layer_body(
-            cfg, block_size, attn_impl, h_carry, lp, kp, vp,
-            slot_mapping, block_tables, kv_lens, positions,
-        )
-        return h_out, (kp, vp)
+    have_win = win_k is not None
+    have_ring = ring_k is not None
 
-    hidden, (kv_k, kv_v) = jax.lax.scan(
-        scan_fn, hidden, (params["layers"], kv_k, kv_v)
-    )
+    def scan_fn(h_carry, xs):
+        lp = xs[0]
+        i = 1
+        wk = wv = rk = rv = None
+        if have_win:
+            wk, wv = xs[i], xs[i + 1]
+            i += 2
+        if have_ring:
+            rk, rv = xs[i], xs[i + 1]
+        h_out, k_l, v_l = _layer_body(
+            cfg, h_carry, lp, positions, chunk_lens,
+            wk, wv, win_len, rk, rv, ring_pos,
+        )
+        return h_out, (k_l, v_l)
+
+    xs = (params["layers"],)
+    if have_win:
+        xs += (win_k, win_v)
+    if have_ring:
+        xs += (ring_k, ring_v)
+    hidden, (k_new, v_new) = jax.lax.scan(scan_fn, hidden, xs)
     hidden = layer_norm(hidden, params["final_ln_w"], params["final_ln_b"])
-    return hidden, kv_k, kv_v
+    return hidden, k_new, v_new
 
 
 def compute_logits(params, cfg, hidden):

@@ -1,7 +1,12 @@
 from production_stack_tpu.ops.attention import (
+    gather_window,
     paged_attention,
     paged_attention_xla,
+    window_attention,
     write_kv_to_pool,
 )
 
-__all__ = ["paged_attention", "paged_attention_xla", "write_kv_to_pool"]
+__all__ = [
+    "gather_window", "paged_attention", "paged_attention_xla",
+    "window_attention", "write_kv_to_pool",
+]

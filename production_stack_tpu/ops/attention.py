@@ -18,12 +18,179 @@ Two implementations behind one dispatch:
 """
 
 import functools
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 _NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+# Query-block size for the chunked prefill path: bounds the materialized
+# score tensor at [Hkv, B, G*QBLOCK, S_total] f32 regardless of chunk length.
+QBLOCK = 256
+
+
+def _seg_scores(qf, keys):
+    """q [Hkv, B, M, Dh] x keys [Hkv, B, S, Dh] -> [Hkv, B, M, S] f32.
+
+    Both operands share leading (Hkv, B) batch dims in the SAME order, so XLA
+    lowers this to a batched matmul with no physical transpose of the keys —
+    load-bearing: a relayout of the KV window would double its HBM traffic.
+    """
+    return jax.lax.dot_general(
+        qf, keys,
+        dimension_numbers=(((3,), (3,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _seg_pv(p, values):
+    """p [Hkv, B, M, S] x values [Hkv, B, S, Dh] -> [Hkv, B, M, Dh] f32."""
+    return jax.lax.dot_general(
+        p.astype(values.dtype), values,
+        dimension_numbers=(((3,), (2,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def window_attention(
+    q: jax.Array,            # [B, T, H, Dh] chunk queries (post-rope)
+    k_chunk: jax.Array,      # [B, T, Hkv, Dh] chunk keys (post-rope)
+    v_chunk: jax.Array,      # [B, T, Hkv, Dh]
+    positions: jax.Array,    # [B, T] absolute position per query token
+    chunk_lens: jax.Array,   # [B] valid (non-pad) tokens per row
+    win_k: Optional[jax.Array] = None,   # [Hkv, B, S, Dh] gathered history
+    win_v: Optional[jax.Array] = None,
+    win_len: Optional[jax.Array] = None,  # [B] valid history per row
+    ring_k: Optional[jax.Array] = None,   # [Hkv, B, R, Dh] intra-dispatch KV
+    ring_v: Optional[jax.Array] = None,
+    ring_pos: Optional[jax.Array] = None,  # [B, R] position per entry
+    *,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Dense attention against up to three key segments, TPU-shaped.
+
+    Replaces the per-layer paged gather of ``paged_attention_xla`` on the hot
+    path: the caller gathers the paged KV pool ONCE per dispatch into a
+    contiguous [Hkv, B, S, Dh] window (slot s holds the sequence's absolute
+    position s), and attention is plain masked batched matmuls that stream at
+    HBM bandwidth — no gather ops inside the step.
+
+    Segments:
+      * window — history tokens already in the pool (valid where s < win_len);
+      * ring   — tokens produced by earlier steps of the SAME fused decode
+        dispatch, not yet scattered to the pool (valid where
+        ring_pos < position; unwritten entries carry a sentinel position);
+      * chunk  — the current tokens themselves, causal within the chunk
+        (valid where position_key <= position_query and key_idx < chunk_len).
+
+    Returns [B, T, H, Dh] in q.dtype.
+    """
+    b, t, h, dh = q.shape
+    hkv = k_chunk.shape[2]
+    g = h // hkv
+    if scale is None:
+        scale = dh ** -0.5
+
+    # [B, T, H, Dh] -> [Hkv, B, G*T, Dh]: (Hkv, B) leading to match segments.
+    qf = (q.astype(jnp.float32) * scale).astype(q.dtype)
+    qf = qf.reshape(b, t, hkv, g, dh).transpose(2, 0, 3, 1, 4)  # [Hkv,B,G,T,Dh]
+    kc = k_chunk.transpose(2, 0, 1, 3)    # [Hkv, B, T, Dh]
+    vc = v_chunk.transpose(2, 0, 1, 3)
+
+    # Additive mask biases, built once per (segment, row[, t]) — f32 {0,-inf}.
+    neg = jnp.float32(_NEG_INF)
+    t_idx = jnp.arange(t, dtype=jnp.int32)
+    chunk_valid = t_idx[None, :] < chunk_lens[:, None]              # [B, T]
+    chunk_bias = jnp.where(
+        chunk_valid[:, None, :] & (positions[:, None, :] <= positions[:, :, None]),
+        0.0, neg,
+    )                                                               # [B, T(q), T(k)]
+    win_bias = ring_bias = None
+    if win_k is not None:
+        s = win_k.shape[2]
+        s_idx = jnp.arange(s, dtype=jnp.int32)
+        win_bias = jnp.where(s_idx[None, :] < win_len[:, None], 0.0, neg)  # [B, S]
+    if ring_k is not None:
+        ring_bias = jnp.where(
+            ring_pos[:, None, :] < positions[:, :, None], 0.0, neg
+        )                                                           # [B, T, R]
+
+    def q_block(qb, cb, rb):
+        # qb: [Hkv, B, G, TQ, Dh]; cb: [B, TQ, T]; rb: [B, TQ, R] or None
+        tq = qb.shape[3]
+        m = g * tq
+        qb = qb.reshape(hkv, b, m, dh)
+        segs = []
+        if win_k is not None:
+            sw = _seg_scores(qb, win_k)
+            segs.append(sw + win_bias[None, :, None, :])
+        if ring_k is not None:
+            sr = _seg_scores(qb, ring_k)
+            rb4 = jnp.broadcast_to(
+                rb[:, None, :, :], (b, g, tq, rb.shape[-1])
+            ).reshape(1, b, m, rb.shape[-1])
+            segs.append(sr + rb4)
+        sc = _seg_scores(qb, kc)
+        cb4 = jnp.broadcast_to(
+            cb[:, None, :, :], (b, g, tq, t)
+        ).reshape(1, b, m, t)
+        segs.append(sc + cb4)
+
+        mx = segs[0].max(-1, keepdims=True)
+        for ss in segs[1:]:
+            mx = jnp.maximum(mx, ss.max(-1, keepdims=True))
+        ps = [jnp.exp(ss - mx) for ss in segs]
+        denom = sum(p.sum(-1, keepdims=True) for p in ps)
+        vals = ([win_v] if win_k is not None else []) + \
+               ([ring_v] if ring_k is not None else []) + [vc]
+        out = sum(_seg_pv(p, val) for p, val in zip(ps, vals))
+        out = out / denom                                   # [Hkv, B, M, Dh]
+        return out.reshape(hkv, b, g, tq, dh)
+
+    if t <= QBLOCK:
+        out = q_block(qf, chunk_bias, ring_bias)
+    else:
+        assert t % QBLOCK == 0, "token bucket must be a multiple of QBLOCK"
+        nb = t // QBLOCK
+        qs = qf.reshape(hkv, b, g, nb, QBLOCK, dh).transpose(3, 0, 1, 2, 4, 5)
+        cbs = chunk_bias.reshape(b, nb, QBLOCK, t).transpose(1, 0, 2, 3)
+        rbs = (
+            ring_bias.reshape(b, nb, QBLOCK, -1).transpose(1, 0, 2, 3)
+            if ring_bias is not None else None
+        )
+
+        def body(_, xs):
+            if rbs is None:
+                qb, cb = xs
+                return (), q_block(qb, cb, None)
+            qb, cb, rb = xs
+            return (), q_block(qb, cb, rb)
+
+        xs = (qs, cbs) if rbs is None else (qs, cbs, rbs)
+        _, outs = jax.lax.scan(body, (), xs)               # [nb, Hkv,B,G,QB,Dh]
+        out = outs.transpose(1, 2, 3, 0, 4, 5).reshape(hkv, b, g, t, dh)
+
+    # [Hkv, B, G, T, Dh] -> [B, T, H, Dh]
+    return out.transpose(1, 3, 0, 2, 4).reshape(b, t, h, dh).astype(q.dtype)
+
+
+def gather_window(
+    kv_k: jax.Array,          # [L, Hkv, num_slots, Dh]
+    kv_v: jax.Array,
+    block_tables: jax.Array,  # [B, Mb] int32
+    block_size: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """One gather per dispatch: paged pool -> contiguous per-sequence windows
+    [L, Hkv, B, Mb*bs, Dh]. Amortized over every layer and every fused decode
+    step of the dispatch (a per-layer gather is ~5 ms/step on a v5e at
+    B=16/S=1024 — the profiled round-1 bottleneck)."""
+    b, mb = block_tables.shape
+    slots = (
+        block_tables[:, :, None] * block_size
+        + jnp.arange(block_size, dtype=block_tables.dtype)[None, None, :]
+    ).reshape(b, mb * block_size)
+    return kv_k[:, :, slots], kv_v[:, :, slots]
 
 
 def gather_kv_pages(pool: jax.Array, block_tables: jax.Array, block_size: int) -> jax.Array:

@@ -8,10 +8,8 @@ import pytest
 import jax.numpy as jnp
 
 
-def _paged_forward_logits(model_dir, token_ids):
-    """Run our model on a fresh paged KV pool; returns [T, V] logits."""
-    import jax
-
+def _forward_logits(model_dir, token_ids):
+    """Run our model's window forward (single chunk, no history); [T, V]."""
     from production_stack_tpu.models import get_model_fns
     from production_stack_tpu.models.config import ModelConfig
     from production_stack_tpu.models.weights import load_hf_params
@@ -21,25 +19,10 @@ def _paged_forward_logits(model_dir, token_ids):
     params = load_hf_params(cfg, model_dir, jnp.float32)
 
     t = len(token_ids)
-    bs = 4
-    num_blocks = 16
-    kv_shape = (cfg.num_layers, cfg.num_kv_heads, num_blocks * bs, cfg.head_dim_)
-    kv_k = jnp.zeros(kv_shape, jnp.float32)
-    kv_v = jnp.zeros(kv_shape, jnp.float32)
     ids = jnp.asarray([token_ids], jnp.int32)
     positions = jnp.arange(t, dtype=jnp.int32)[None]
-    # Blocks 1..n in order; slot for position p = (1 + p//bs)*bs + p%bs.
-    slot_mapping = jnp.asarray(
-        [[(1 + p // bs) * bs + p % bs for p in range(t)]], jnp.int32
-    )
-    block_tables = jnp.asarray(
-        [list(range(1, num_blocks))], jnp.int32
-    )
-    kv_lens = jnp.asarray([t], jnp.int32)
-    hidden, _, _ = forward(
-        params, cfg, ids, positions, kv_k, kv_v, slot_mapping,
-        block_tables, kv_lens, block_size=bs, attn_impl="xla",
-    )
+    chunk_lens = jnp.asarray([t], jnp.int32)
+    hidden, _, _ = forward(params, cfg, ids, positions, chunk_lens)
     return np.asarray(logits_fn(params, cfg, hidden[0]))
 
 
@@ -72,5 +55,5 @@ def test_hf_checkpoint_forward_parity(tmp_path, family):
     with torch.no_grad():
         ref = model(torch.tensor([token_ids])).logits[0].numpy()
 
-    ours = _paged_forward_logits(model_dir, token_ids)
+    ours = _forward_logits(model_dir, token_ids)
     np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
