@@ -183,6 +183,22 @@ class ServingEngine:
             if not seq.status.is_finished:
                 self.abort(request_id)
 
+    async def embed(self, texts: List[str]):
+        """Embed texts (mean-pooled trunk states). Returns (vectors [n, D]
+        float32 numpy, total prompt tokens). Runs off-loop; does not touch
+        the KV pool, so it is safe alongside in-flight generate steps."""
+        loop = asyncio.get_running_loop()
+        token_lists = [
+            (self.tokenizer.encode(t) or [self.tokenizer.eos_token_id or 0])[
+                : self.config.max_model_len
+            ]
+            for t in texts
+        ]
+        vecs = await loop.run_in_executor(None, self.runner.embed, token_lists)
+        n_tokens = sum(len(t) for t in token_lists)
+        self.prompt_tokens_total += n_tokens
+        return vecs, n_tokens
+
     def abort(self, request_id: str) -> None:
         """Deferred abort: applied by the engine loop between device steps."""
         self._pending_aborts.add(request_id)

@@ -438,6 +438,58 @@ class ModelRunner:
             return self._execute_decode(batch)
         return self._execute_prefill(batch)
 
+    # -------------------------------------------------------------- embedding
+    @functools.cached_property
+    def _embed_jit(self):
+        """Mean-pooled, L2-normalized final hidden states (no KV pool touch).
+
+        Serves /v1/embeddings and /v1/rerank (the reference router proxies
+        both — src/vllm_router/app.py routes — to engines; here the engine
+        itself provides them from the causal LM trunk)."""
+
+        def embed(params, token_ids, lens):
+            b, t = token_ids.shape
+            positions = jnp.broadcast_to(
+                jnp.arange(t, dtype=jnp.int32)[None, :], (b, t)
+            )
+            hidden, _, _ = self._forward(
+                params, self.model_config, token_ids, positions, lens,
+                None, None, None,
+            )
+            mask = (jnp.arange(t, dtype=jnp.int32)[None, :] < lens[:, None])
+            maskf = mask.astype(jnp.float32)[:, :, None]
+            denom = jnp.maximum(lens[:, None].astype(jnp.float32), 1.0)
+            pooled = (hidden.astype(jnp.float32) * maskf).sum(1) / denom
+            norm = jnp.maximum(
+                jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9
+            )
+            return pooled / norm
+
+        return jax.jit(embed)
+
+    def embed(self, token_lists: List[List[int]]) -> np.ndarray:
+        """[n, hidden] float32 embeddings for tokenized inputs. Inputs beyond
+        max_num_seqs are processed in chunks."""
+        cap = max(1, self.config.max_num_seqs)
+        outs = []
+        for ofs in range(0, len(token_lists), cap):
+            chunk = token_lists[ofs:ofs + cap]
+            n = len(chunk)
+            b = _bucket(n, 1, cap)
+            t = _bucket(max((len(x) for x in chunk), default=1), 16,
+                        max(16, self.config.max_model_len))
+            token_ids = np.zeros((b, t), np.int32)
+            lens = np.zeros((b,), np.int32)
+            for i, toks in enumerate(chunk):
+                toks = toks[:t]
+                token_ids[i, :len(toks)] = toks
+                lens[i] = len(toks)
+            out = self._embed_jit(
+                self.params, jnp.asarray(token_ids), jnp.asarray(lens)
+            )
+            outs.append(np.asarray(out)[:n])
+        return np.concatenate(outs, axis=0)
+
     # ------------------------------------------------------------ KV offload
     def _block_slots(self, block_ids: List[int], n_bucket: int) -> np.ndarray:
         bs = self.config.block_size

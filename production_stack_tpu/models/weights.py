@@ -73,6 +73,20 @@ _OPT_TOP = {
 }
 
 
+def _required_layer_leaves(cfg: ModelConfig) -> set:
+    """Per-layer leaves every valid checkpoint must provide for the arch."""
+    if cfg.arch == "llama":
+        req = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+               "attn_norm", "mlp_norm"}
+        if cfg.attention_bias:
+            req |= {"bq", "bk", "bv"}
+        return req
+    # OPT: the forward unconditionally reads the bias/norm leaves too.
+    return {"wq", "wk", "wv", "wo", "bq", "bk", "bv", "bo",
+            "fc1", "fc1_b", "fc2", "fc2_b",
+            "ln1_w", "ln1_b", "ln2_w", "ln2_b"}
+
+
 def _iter_checkpoint_tensors(model_dir: str) -> Iterator[Tuple[str, np.ndarray]]:
     """Yield (hf_name, numpy array) streaming over checkpoint shards."""
     st_files = sorted(
@@ -124,7 +138,7 @@ def load_hf_params(
     nl = cfg.num_layers
 
     stacks: Dict[str, np.ndarray] = {}   # our layer leaf -> [L, ...] buffer
-    filled: Dict[str, int] = {}
+    filled: Dict[str, set] = {}          # our layer leaf -> set of layer idxs
     top: Dict[str, np.ndarray] = {}
 
     for hf_name, tensor in _iter_checkpoint_tensors(model_dir):
@@ -140,9 +154,14 @@ def load_hf_params(
             t = tensor.T if transpose else tensor
             if ours not in stacks:
                 stacks[ours] = np.empty((nl,) + t.shape, t.dtype)
-                filled[ours] = 0
+                filled[ours] = set()
+            if layer_idx >= nl:
+                raise ValueError(
+                    f"Checkpoint tensor {hf_name} indexes layer {layer_idx} "
+                    f"but the config has only {nl} layers"
+                )
             stacks[ours][layer_idx] = t
-            filled[ours] += 1
+            filled[ours].add(layer_idx)
         else:
             mapped = top_map.get(hf_name)
             if mapped is None:
@@ -151,11 +170,23 @@ def load_hf_params(
             ours, transpose = mapped
             top[ours] = tensor.T if transpose else tensor
 
-    missing = [k for k, n in filled.items() if n != nl]
-    if missing:
+    # Completeness is checked per LAYER-INDEX SET, not by count: a sharded
+    # checkpoint that repeats layer 0 and omits layer 7 has the right count
+    # but would serve garbage for the missing layer.
+    all_layers = set(range(nl))
+    holes = {
+        k: sorted(all_layers - s) for k, s in filled.items()
+        if s != all_layers
+    }
+    if holes:
         raise ValueError(
-            f"Incomplete checkpoint: {missing} have "
-            f"{[filled[k] for k in missing]} of {nl} layers"
+            f"Incomplete checkpoint: missing layer indices {holes}"
+        )
+    required = _required_layer_leaves(cfg)
+    absent = required - set(stacks)
+    if absent:
+        raise ValueError(
+            f"Incomplete checkpoint: no tensors at all for {sorted(absent)}"
         )
 
     params: Dict = {"layers": {}}

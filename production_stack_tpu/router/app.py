@@ -67,16 +67,19 @@ logger = init_logger(__name__)
 
 # --------------------------------------------------------------- API handlers
 async def handle_chat_completions(request: web.Request) -> web.StreamResponse:
-    cache = request.app.get("semantic_cache")
-    if cache is not None:
-        hit = await cache.check(request)
-        if hit is not None:
-            return hit
+    # PII runs BEFORE the semantic cache so PII-bearing prompts are never
+    # embedded/persisted by the cache (advisor r1/r2 finding); the cache then
+    # sees the redacted body.
     pii = request.app.get("pii_checker")
     if pii is not None:
         blocked = await pii.check(request)
         if blocked is not None:
             return blocked
+    cache = request.app.get("semantic_cache")
+    if cache is not None:
+        hit = await cache.check(request)
+        if hit is not None:
+            return hit
     return await route_general_request(request, "/v1/chat/completions")
 
 
@@ -269,9 +272,16 @@ def initialize_all(app: web.Application, args) -> None:
 
         app["semantic_cache"] = SemanticCache()
     if gates.enabled(PII_DETECTION):
-        from production_stack_tpu.router.pii import PIIChecker
+        from production_stack_tpu.router.pii import (
+            PIIAction,
+            PIIChecker,
+            create_analyzer,
+        )
 
-        app["pii_checker"] = PIIChecker()
+        app["pii_checker"] = PIIChecker(
+            action=PIIAction(getattr(args, "pii_action", "block")),
+            analyzer=create_analyzer(getattr(args, "pii_analyzer", "regex")),
+        )
 
     if args.enable_batch_api:
         import os

@@ -39,6 +39,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--dynamic-config-json", default=None)
     p.add_argument("--feature-gates", default="",
                    help="comma-separated Name=true|false gates")
+    p.add_argument("--pii-action", choices=["block", "redact"],
+                   default="block",
+                   help="what to do on PII detection (PIIDetection gate)")
+    p.add_argument("--pii-analyzer", default="regex")
 
     p.add_argument("--enable-batch-api", action="store_true")
     p.add_argument("--file-storage-class", default="local_file")

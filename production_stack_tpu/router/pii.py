@@ -93,9 +93,44 @@ class PIIChecker:
     action: PIIAction = PIIAction.BLOCK
     analyzer: object = field(default_factory=RegexAnalyzer)
 
+    def _redact_text(self, text: str) -> str:
+        """Replace every match with ``[REDACTED:<type>]``.
+
+        Overlapping matches (a credit card whose prefix also matches the
+        phone pattern) are MERGED into one span first, so replacements always
+        slice the original string — naive sequential replacement would apply
+        stale offsets to the rewritten string and leak span tails."""
+        matches = sorted(self.analyzer.analyze(text), key=lambda m: m.start)
+        if not matches:
+            return text
+        merged = []  # (start, end, type) non-overlapping, in order
+        cur_s, cur_e, cur_t = matches[0].start, matches[0].end, \
+            matches[0].pii_type.value
+        for m in matches[1:]:
+            if m.start < cur_e:  # overlap: extend, keep the wider span's type
+                if m.end > cur_e:
+                    cur_e = m.end
+                    cur_t = m.pii_type.value
+            else:
+                merged.append((cur_s, cur_e, cur_t))
+                cur_s, cur_e, cur_t = m.start, m.end, m.pii_type.value
+        merged.append((cur_s, cur_e, cur_t))
+        out, prev = [], 0
+        for s, e, t in merged:
+            out.append(text[prev:s])
+            out.append(f"[REDACTED:{t}]")
+            prev = e
+        out.append(text[prev:])
+        return "".join(out)
+
     async def check(self, request: web.Request) -> Optional[web.Response]:
-        """Scan message/prompt text; return a 400 response to block, or None
-        (after in-place redaction when action=redact)."""
+        """Scan message/prompt text. Returns a 400 response to block, or None.
+
+        In REDACT mode the matched spans are replaced in a COPY of the body
+        and the serialized result is stashed at ``request["pii_redacted_body"]``
+        — downstream consumers (proxy, semantic cache) use it in place of the
+        raw body, so PII never reaches a backend or the cache (closes the
+        reference middleware.py:103-154 REDACT contract)."""
         try:
             body = json.loads(await request.read())
         except (json.JSONDecodeError, UnicodeDecodeError):
@@ -124,4 +159,12 @@ class PIIChecker:
                 ).to_dict(),
                 status=400,
             )
-        return None  # redact mode: handled by rewriter in a later phase
+        # REDACT: rewrite in place and hand the sanitized body downstream.
+        for m in body.get("messages", []) or []:
+            if isinstance(m.get("content"), str):
+                m["content"] = self._redact_text(m["content"])
+        if isinstance(body.get("prompt"), str):
+            body["prompt"] = self._redact_text(body["prompt"])
+        logger.info("Redacted PII from request: %s", types)
+        request["pii_redacted_body"] = json.dumps(body).encode()
+        return None

@@ -54,7 +54,8 @@ async def route_general_request(
     app = request.app
     in_time = time.time()
     try:
-        body_bytes = await request.read()
+        # A PII REDACT pass may have replaced the body (router/pii.py).
+        body_bytes = request.get("pii_redacted_body") or await request.read()
         body = json.loads(body_bytes) if body_bytes else {}
     except (json.JSONDecodeError, UnicodeDecodeError):
         return _error(400, "Request body is not valid JSON")

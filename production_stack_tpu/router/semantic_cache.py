@@ -168,7 +168,8 @@ class SemanticCache:
     async def check(self, request: web.Request) -> Optional[web.Response]:
         """Pre-routing hook: return a cached response on similarity hit."""
         try:
-            body = json.loads(await request.read())
+            raw = request.get("pii_redacted_body") or await request.read()
+            body = json.loads(raw)
         except (json.JSONDecodeError, UnicodeDecodeError):
             return None
         if body.get("stream"):

@@ -15,6 +15,7 @@ blocking `requests` call per pod event.
 
 import asyncio
 import json
+import os
 import ssl
 import threading
 import time
@@ -205,8 +206,17 @@ class K8sPodIPServiceDiscovery(ServiceDiscovery):
         return bool(statuses) and all(s.get("ready") for s in statuses)
 
     async def _probe_models(self, session, url: str) -> List[str]:
+        # Engines behind --api-key expect the probe to authenticate with the
+        # shared VLLM_API_KEY, like the reference probe
+        # (reference src/vllm_router/service_discovery.py:156-169).
+        headers = {}
+        api_key = os.environ.get("VLLM_API_KEY")
+        if api_key:
+            headers["Authorization"] = f"Bearer {api_key}"
         try:
-            async with session.get(f"{url}/v1/models", ssl=False) as resp:
+            async with session.get(
+                f"{url}/v1/models", ssl=False, headers=headers
+            ) as resp:
                 data = await resp.json()
                 return [m["id"] for m in data.get("data", [])]
         except Exception:  # noqa: BLE001 — pod may not be serving yet

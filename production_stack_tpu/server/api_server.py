@@ -47,13 +47,28 @@ def _sse(obj: dict) -> bytes:
 
 
 class APIServer:
-    def __init__(self, engine: ServingEngine):
+    def __init__(self, engine: ServingEngine, api_key: Optional[str] = None):
         self.engine = engine
         self.model_name = engine.config.model_name
+        # Bearer auth parity: the reference stack passes VLLM_API_KEY to
+        # engines and the router probe authenticates with it
+        # (reference src/vllm_router/service_discovery.py:156-169).
+        self.api_key = api_key
 
     # ----------------------------------------------------------------- routes
     def build_app(self) -> web.Application:
-        app = web.Application(client_max_size=64 * 1024 * 1024)
+        @web.middleware
+        async def auth(request: web.Request, handler):
+            if self.api_key and (request.path.startswith("/v1")
+                                 or request.path == "/rerank"):
+                if request.headers.get("Authorization") != \
+                        f"Bearer {self.api_key}":
+                    return _error(401, "Invalid or missing API key",
+                                  etype="authentication_error")
+            return await handler(request)
+
+        app = web.Application(client_max_size=64 * 1024 * 1024,
+                              middlewares=[auth])
 
         async def on_startup(app):
             await self.engine.start()
@@ -65,11 +80,89 @@ class APIServer:
         app.on_cleanup.append(on_cleanup)
         app.router.add_post("/v1/chat/completions", self.chat_completions)
         app.router.add_post("/v1/completions", self.completions)
+        app.router.add_post("/v1/embeddings", self.embeddings)
+        app.router.add_post("/v1/rerank", self.rerank)
+        app.router.add_post("/rerank", self.rerank)
         app.router.add_get("/v1/models", self.models)
         app.router.add_get("/health", self.health)
         app.router.add_get("/metrics", self.metrics)
         app.router.add_get("/version", self.version)
         return app
+
+    # ------------------------------------------------------------- embeddings
+    async def embeddings(self, request: web.Request) -> web.Response:
+        try:
+            body = json.loads(await request.read())
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return _error(400, "Request body is not valid JSON")
+        inputs = body.get("input")
+        if inputs is None:
+            return _error(400, "'input' is required")
+        if isinstance(inputs, str):
+            inputs = [inputs]
+        if not inputs or not all(isinstance(x, str) for x in inputs):
+            return _error(400, "'input' must be a string or list of strings")
+        model = body.get("model", self.model_name)
+        if model != self.model_name:
+            return _error(404, f"Model '{model}' not found",
+                          etype="model_not_found")
+        vecs, n_tokens = await self.engine.embed(inputs)
+        return web.json_response({
+            "object": "list",
+            "data": [
+                {"object": "embedding", "index": i,
+                 "embedding": [float(x) for x in vec]}
+                for i, vec in enumerate(vecs)
+            ],
+            "model": self.model_name,
+            "usage": {"prompt_tokens": n_tokens, "total_tokens": n_tokens},
+        })
+
+    async def rerank(self, request: web.Request) -> web.Response:
+        """Cosine-similarity rerank over trunk embeddings (vLLM /rerank shape)."""
+        try:
+            body = json.loads(await request.read())
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return _error(400, "Request body is not valid JSON")
+        query = body.get("query")
+        documents = body.get("documents")
+        if not isinstance(query, str) or not isinstance(documents, list) \
+                or not all(isinstance(d, str) for d in documents):
+            return _error(400, "'query' (str) and 'documents' (list[str]) "
+                               "are required")
+        model = body.get("model", self.model_name)
+        if model != self.model_name:
+            return _error(404, f"Model '{model}' not found",
+                          etype="model_not_found")
+        if not documents:
+            return web.json_response({
+                "id": random_uuid("rerank-"), "model": self.model_name,
+                "results": [],
+                "usage": {"prompt_tokens": 0, "total_tokens": 0},
+            })
+        top_n = body.get("top_n")
+        if top_n is None:
+            top_n = len(documents)
+        elif not isinstance(top_n, int) or top_n < 0:
+            return _error(400, "'top_n' must be a non-negative integer")
+        vecs, n_tokens = await self.engine.embed([query] + documents)
+        qv, dv = vecs[0], vecs[1:]
+        scores = dv @ qv  # embeddings are L2-normalized -> cosine similarity
+        order = scores.argsort()[::-1]
+        results = [
+            {
+                "index": int(i),
+                "document": {"text": documents[int(i)]},
+                "relevance_score": float(scores[int(i)]),
+            }
+            for i in order[:top_n]
+        ]
+        return web.json_response({
+            "id": random_uuid("rerank-"),
+            "model": self.model_name,
+            "results": results,
+            "usage": {"prompt_tokens": n_tokens, "total_tokens": n_tokens},
+        })
 
     async def models(self, request: web.Request) -> web.Response:
         return web.json_response(
@@ -286,6 +379,7 @@ def build_engine_from_args(args: argparse.Namespace) -> ServingEngine:
         data_parallel_size=args.data_parallel_size,
         num_decode_steps=args.num_decode_steps,
         attn_impl=args.attn_impl,
+        enable_warmup=not args.no_warmup,
     )
     return ServingEngine(cfg)
 
@@ -311,13 +405,20 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--num-decode-steps", type=int, default=8)
     p.add_argument("--attn-impl", default="auto",
                    choices=["auto", "xla", "pallas"])
+    p.add_argument("--no-warmup", action="store_true",
+                   help="Skip AOT warmup compilation at startup")
+    import os
+
+    p.add_argument("--api-key", default=os.environ.get("VLLM_API_KEY"),
+                   help="Require 'Authorization: Bearer <key>' on /v1/* "
+                        "(defaults to $VLLM_API_KEY)")
     return p.parse_args(argv)
 
 
 def main(argv=None) -> None:
     args = parse_args(argv)
     engine = build_engine_from_args(args)
-    server = APIServer(engine)
+    server = APIServer(engine, api_key=args.api_key)
     app = server.build_app()
     logger.info("Engine API server on %s:%d (model=%s)",
                 args.host, args.port, server.model_name)

@@ -1,0 +1,207 @@
+"""Round-3 parity sweep: console entry points, Bearer auth, engine-side
+embeddings/rerank, PII REDACT, per-layer checkpoint completeness.
+
+Covers the launch-blocking items from the reference contract: console scripts
+(reference pyproject [project.scripts]), probe auth (reference
+src/vllm_router/service_discovery.py:156-169), and the /v1/embeddings +
+/v1/rerank endpoints the router advertises.
+"""
+
+import importlib
+import json
+import re
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.engine import ServingEngine
+from production_stack_tpu.server.api_server import APIServer
+
+
+# --------------------------------------------------------- console scripts
+def test_console_entry_points_import():
+    """Every [project.scripts] target must import and expose its function."""
+    import pathlib
+
+    text = (pathlib.Path(__file__).parent.parent / "pyproject.toml").read_text()
+    entries = re.findall(
+        r'^\s*[\w-]+\s*=\s*"([\w.]+):(\w+)"\s*$', text, re.MULTILINE
+    )
+    assert len(entries) >= 3, "expected router/engine/cache-server scripts"
+    for module_path, func_name in entries:
+        mod = importlib.import_module(module_path)
+        assert callable(getattr(mod, func_name)), f"{module_path}:{func_name}"
+
+
+# ------------------------------------------------- engine auth + embeddings
+@pytest.fixture()
+def engine_cfg():
+    return EngineConfig(
+        model="tiny-llama", max_model_len=256, block_size=4,
+        num_kv_blocks=128, max_num_seqs=8, max_num_batched_tokens=32,
+        attn_impl="xla",
+    )
+
+
+async def _client(cfg, api_key=None):
+    server = APIServer(ServingEngine(cfg), api_key=api_key)
+    client = TestClient(TestServer(server.build_app()))
+    await client.start_server()
+    return client
+
+
+async def test_bearer_auth(engine_cfg):
+    client = await _client(engine_cfg, api_key="sekrit")
+    try:
+        resp = await client.get("/v1/models")
+        assert resp.status == 401
+        resp = await client.get(
+            "/v1/models", headers={"Authorization": "Bearer wrong"}
+        )
+        assert resp.status == 401
+        resp = await client.get(
+            "/v1/models", headers={"Authorization": "Bearer sekrit"}
+        )
+        assert resp.status == 200
+        # health/metrics stay open for k8s probes + Prometheus
+        assert (await client.get("/health")).status == 200
+        assert (await client.get("/metrics")).status == 200
+    finally:
+        await client.close()
+
+
+async def test_embeddings_endpoint(engine_cfg):
+    client = await _client(engine_cfg)
+    try:
+        resp = await client.post("/v1/embeddings", json={
+            "model": "tiny-llama", "input": ["hello world", "goodbye"],
+        })
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["object"] == "list"
+        assert len(body["data"]) == 2
+        vec = np.asarray(body["data"][0]["embedding"])
+        assert vec.ndim == 1 and len(vec) > 0
+        assert abs(float(np.linalg.norm(vec)) - 1.0) < 1e-3  # L2-normalized
+        assert body["usage"]["prompt_tokens"] > 0
+
+        # Same text -> same embedding; different text -> different.
+        resp2 = await client.post("/v1/embeddings", json={
+            "model": "tiny-llama", "input": "hello world",
+        })
+        vec2 = np.asarray((await resp2.json())["data"][0]["embedding"])
+        np.testing.assert_allclose(vec, vec2, atol=1e-4)
+
+        resp = await client.post("/v1/embeddings", json={"model": "tiny-llama"})
+        assert resp.status == 400
+    finally:
+        await client.close()
+
+
+async def test_rerank_endpoint(engine_cfg):
+    client = await _client(engine_cfg)
+    try:
+        docs = ["the cat sat on the mat", "quantum field theory",
+                "a cat and a dog"]
+        resp = await client.post("/v1/rerank", json={
+            "model": "tiny-llama", "query": "cats", "documents": docs,
+            "top_n": 2,
+        })
+        assert resp.status == 200
+        body = await resp.json()
+        assert len(body["results"]) == 2
+        scores = [r["relevance_score"] for r in body["results"]]
+        assert scores == sorted(scores, reverse=True)
+        assert body["results"][0]["document"]["text"] in docs
+    finally:
+        await client.close()
+
+
+# ---------------------------------------------------------------- PII redact
+async def test_pii_redact_flows_downstream():
+    from production_stack_tpu.router.pii import PIIAction, PIIChecker
+
+    checker = PIIChecker(action=PIIAction.REDACT)
+
+    class FakeRequest(dict):
+        async def read(self):
+            return json.dumps({
+                "model": "m",
+                "messages": [{"role": "user",
+                              "content": "mail me at bob@example.com please"}],
+                "prompt": "my ssn is 123-45-6789",
+            }).encode()
+
+    req = FakeRequest()
+    resp = await checker.check(req)
+    assert resp is None  # redact never blocks
+    redacted = json.loads(req["pii_redacted_body"])
+    assert "bob@example.com" not in json.dumps(redacted)
+    assert "123-45-6789" not in json.dumps(redacted)
+    assert "[REDACTED:email]" in redacted["messages"][0]["content"]
+    assert "[REDACTED:ssn]" in redacted["prompt"]
+
+
+def test_pii_redact_overlapping_spans_no_leak():
+    """Overlapping matches (phone prefix inside a credit card) must not leak
+    span tails through stale offsets (code-review r3 finding)."""
+    from production_stack_tpu.router.pii import PIIAction, PIIChecker
+
+    checker = PIIChecker(action=PIIAction.REDACT)
+    out = checker._redact_text("pay 123-456-7890-1234 now")
+    assert "1234" not in out
+    assert out.startswith("pay [REDACTED:") and out.endswith("now")
+
+
+async def test_pii_block_still_blocks():
+    from production_stack_tpu.router.pii import PIIAction, PIIChecker
+
+    checker = PIIChecker(action=PIIAction.BLOCK)
+
+    class FakeRequest(dict):
+        async def read(self):
+            return json.dumps({"prompt": "card 4111 1111 1111 1111"}).encode()
+
+    resp = await checker.check(FakeRequest())
+    assert resp is not None and resp.status == 400
+
+
+# ------------------------------------------------- checkpoint completeness
+def test_checkpoint_missing_layer_detected(tmp_path):
+    """A checkpoint that repeats layer 0 but omits layer 1 must fail even
+    though the per-leaf tensor COUNT matches (advisor r1/r2 finding)."""
+    st = pytest.importorskip("safetensors.numpy")
+    from production_stack_tpu.models.config import resolve_model_config
+    from production_stack_tpu.models.weights import load_hf_params
+
+    cfg = resolve_model_config("tiny-llama")
+    d, dh = cfg.hidden_size, cfg.head_dim_
+    h, hkv, f = cfg.num_heads, cfg.num_kv_heads, cfg.intermediate_size
+    tensors = {
+        "model.embed_tokens.weight": np.zeros((cfg.vocab_size, d), np.float32),
+        "model.norm.weight": np.ones((d,), np.float32),
+    }
+    suffixes = {
+        "self_attn.q_proj.weight": (h * dh, d),
+        "self_attn.k_proj.weight": (hkv * dh, d),
+        "self_attn.v_proj.weight": (hkv * dh, d),
+        "self_attn.o_proj.weight": (d, h * dh),
+        "mlp.gate_proj.weight": (f, d),
+        "mlp.up_proj.weight": (f, d),
+        "mlp.down_proj.weight": (d, f),
+        "input_layernorm.weight": (d,),
+        "post_attention_layernorm.weight": (d,),
+    }
+    # Every leaf appears num_layers times... but all at layer index 0 except
+    # one leaf that covers the full range (so counts alone look plausible).
+    for suffix, shape in suffixes.items():
+        for i in range(cfg.num_layers):
+            idx = 0 if suffix == "self_attn.q_proj.weight" else i
+            tensors[f"model.layers.{idx}.{suffix}"] = np.zeros(
+                shape, np.float32
+            )
+    st.save_file(tensors, str(tmp_path / "model.safetensors"))
+    with pytest.raises(ValueError, match="missing layer"):
+        load_hf_params(cfg, str(tmp_path), np.float32)
