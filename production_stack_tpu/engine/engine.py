@@ -284,10 +284,12 @@ class ServingEngine:
                 # Drop sampled-past-the-stop tokens (the fused K-step decode
                 # can overshoot a stop match by up to K-1 tokens) so token_ids
                 # and usage reflect the delivered text, not the speculation.
-                # Binary search for the smallest kept prefix: decode length is
-                # monotone in token count, and this runs at most once per
-                # request, so the cost is O(n log n) rather than the naive
-                # per-token re-decode's O(n^2).
+                # Binary search for the smallest kept prefix, then verify with
+                # a short linear walk: decode length is NOT strictly monotone
+                # in token count (a prefix ending in dangling UTF-8 bytes can
+                # decode to several replacement chars that collapse once the
+                # next token completes the sequence), so the search may land a
+                # token off and the walk corrects it.
                 toks = seq.output_token_ids
                 lo, hi = 0, len(toks)
                 while lo < hi:
@@ -296,6 +298,12 @@ class ServingEngine:
                         lo = mid + 1
                     else:
                         hi = mid
+                while lo < len(toks) and \
+                        len(self.tokenizer.decode(toks[:lo])) < idx:
+                    lo += 1
+                while lo > 0 and \
+                        len(self.tokenizer.decode(toks[:lo - 1])) >= idx:
+                    lo -= 1
                 self.generation_tokens_total -= len(toks) - lo
                 seq.output_token_ids = toks[:lo]
                 if finished:

@@ -476,8 +476,13 @@ class ModelRunner:
             chunk = token_lists[ofs:ofs + cap]
             n = len(chunk)
             b = _bucket(n, 1, cap)
-            t = _bucket(max((len(x) for x in chunk), default=1), 16,
-                        max(16, self.config.max_model_len))
+            # hi must itself be a power of two: a non-pow2 max_model_len
+            # (e.g. 3000) would clamp t to a non-multiple of QBLOCK and trip
+            # window_attention's chunking assert.
+            hi = 16
+            while hi < self.config.max_model_len:
+                hi *= 2
+            t = _bucket(max((len(x) for x in chunk), default=1), 16, hi)
             token_ids = np.zeros((b, t), np.int32)
             lens = np.zeros((b,), np.int32)
             for i, toks in enumerate(chunk):

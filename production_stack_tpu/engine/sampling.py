@@ -7,14 +7,15 @@ TPU discipline: a full-vocab argsort costs ~5 ms/step on a v5e (the sorted
 take_along_axis gather runs at ~1.5 GB/s, profiled), so the sampler never
 sorts on the common paths:
   * greedy rows use argmax;
-  * unfiltered sampling (no top-k/top-p) uses the Gumbel-argmax trick over the
+  * unfiltered rows (no top-k/top-p) use the Gumbel-argmax trick over the
     full vocab — exact softmax sampling, sort-free;
-  * filtered rows take a lax.cond branch that reduces the vocab to the top
-    TOP_CANDIDATES logits via lax.top_k (O(V) per candidate, no full sort)
-    and applies top-k/top-p masks among those candidates.
-The filtered branch therefore truncates top-p to the TOP_CANDIDATES most
-likely tokens; mass beyond rank 128 is vanishingly small for real LLM logits
-(vLLM's TPU backend makes the same tradeoff).
+  * filtered rows reduce the vocab to the top TOP_CANDIDATES logits via
+    lax.top_k (O(V) per candidate, no full sort) and apply top-k/top-p masks
+    among those candidates.
+Path selection is PER ROW (jnp.where over both picks) so a request's tokens
+never depend on co-batched requests. The filtered path truncates top-p to the
+TOP_CANDIDATES most likely tokens; mass beyond rank 128 is vanishingly small
+for real LLM logits (vLLM's TPU backend makes the same tradeoff).
 """
 
 from dataclasses import dataclass, field
@@ -96,32 +97,39 @@ def sample_tokens(
     top_p: jax.Array,        # [B]
     seeds: jax.Array,        # [B] uint32 per-row PRNG seeds
 ) -> jax.Array:
+    """Per-ROW path selection: a row with top_k/top_p takes the truncated
+    candidate pick; an unfiltered row takes the exact full-vocab Gumbel pick.
+    One shared Gumbel field [B, V] feeds both (the candidate branch gathers
+    its noise at the candidate indices), so a row's sampled token depends only
+    on its own (logits, params, seed) — never on which rows it was batched
+    with. A batch-global lax.cond here silently top-128-truncated unfiltered
+    rows whenever ANY co-batched row had filtering on, breaking the
+    per-sequence determinism contract of runner._token_seed."""
     b, v = logits.shape
     greedy = jnp.argmax(logits, axis=-1)
 
     temp = jnp.maximum(temperature, 1e-6)[:, None]
     scaled = logits / temp
 
-    needs_filter = jnp.any((top_k > 0) | (top_p < 1.0))
+    g = _gumbel(seeds, (b, v))
+    # Exact softmax sampling without a sort: argmax(logits/T + Gumbel).
+    unfiltered_pick = jnp.argmax(scaled + g, axis=-1)
 
-    def unfiltered(_):
-        # Exact softmax sampling without a sort: argmax(logits/T + Gumbel).
-        return jnp.argmax(scaled + _gumbel(seeds, (b, v)), axis=-1)
+    c = min(TOP_CANDIDATES, v)
+    cand_logits, cand_idx = jax.lax.top_k(scaled, c)       # [B, C] desc
+    probs = jax.nn.softmax(cand_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    ranks = jnp.arange(c, dtype=jnp.int32)[None, :]
+    k_eff = jnp.where(top_k[:, None] <= 0, c, top_k[:, None])
+    keep = (ranks < k_eff) & ((cum - probs) < top_p[:, None])
+    keep = keep.at[:, 0].set(True)
+    masked = jnp.where(keep, cand_logits, -jnp.inf)
+    g_cand = jnp.take_along_axis(g, cand_idx, axis=-1)     # [B, C]
+    pick = jnp.argmax(masked + g_cand, axis=-1)
+    filtered_pick = jnp.take_along_axis(cand_idx, pick[:, None], axis=-1)[:, 0]
 
-    def filtered(_):
-        c = min(TOP_CANDIDATES, v)
-        cand_logits, cand_idx = jax.lax.top_k(scaled, c)   # [B, C] desc
-        probs = jax.nn.softmax(cand_logits, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        ranks = jnp.arange(c, dtype=jnp.int32)[None, :]
-        k_eff = jnp.where(top_k[:, None] < 0, c, top_k[:, None])
-        keep = (ranks < k_eff) & ((cum - probs) < top_p[:, None])
-        keep = keep.at[:, 0].set(True)
-        masked = jnp.where(keep, cand_logits, -jnp.inf)
-        pick = jnp.argmax(masked + _gumbel(seeds, (b, c)), axis=-1)
-        return jnp.take_along_axis(cand_idx, pick[:, None], axis=-1)[:, 0]
-
-    sampled = jax.lax.cond(needs_filter, filtered, unfiltered, None)
+    row_filtered = (top_k > 0) | (top_p < 1.0)
+    sampled = jnp.where(row_filtered, filtered_pick, unfiltered_pick)
     return jnp.where(temperature <= 0.0, greedy, sampled)
 
 

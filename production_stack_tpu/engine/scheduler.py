@@ -180,12 +180,10 @@ class Scheduler:
         if not self.waiting or max_rows <= 0:
             return None
         budget = cfg.max_num_batched_tokens
-        seqs: List[Sequence] = []
-        starts: List[int] = []
-        lens: List[int] = []
-        chunk_cap = None
+        cands: List[Sequence] = []
+        newly_allocated: set = set()
         for cand in list(self.waiting):
-            if len(seqs) >= max_rows:
+            if len(cands) >= max_rows:
                 break
             if not cand.block_ids:
                 alloc = self.block_manager.allocate_prompt(cand.all_token_ids)
@@ -193,6 +191,7 @@ class Scheduler:
                     continue  # starved; a later cand may already hold blocks
                 cand.block_ids, cand.num_cached_tokens = alloc
                 cand.num_computed_tokens = cand.num_cached_tokens
+                newly_allocated.add(cand.request_id)
                 if self.offload is not None:
                     # Host/remote KV tiers may extend the cached prefix past
                     # what survived in device HBM (LMCache-equivalent path).
@@ -202,24 +201,43 @@ class Scheduler:
                     )
                     cand.num_computed_tokens += restored
                     cand.num_cached_tokens += restored
-            start = cand.num_computed_tokens
-            # NOTE: a preempted sequence re-prefills prompt+output together.
-            remaining = cand.num_tokens - start
-            if chunk_cap is None:
-                chunk_cap = min(remaining, budget)
-                # Rows are padded to a shared power-of-two token bucket; count
-                # the PADDED width against the budget so admission reflects
-                # actual device compute.
-                t_bucket = 16
-                while t_bucket < chunk_cap:
-                    t_bucket *= 2
-            elif (len(seqs) + 1) * t_bucket > budget:
-                break
-            seqs.append(cand)
-            starts.append(start)
-            lens.append(min(remaining, chunk_cap))
-        if not seqs:
+            cands.append(cand)
+        if not cands:
             return None
+        # Shared padded chunk width: a fair share of the budget over the
+        # admitted rows, NOT the queue head's remaining tail — a head with 16
+        # leftover tokens must not cap co-scheduled fresh prompts at 16
+        # (advisor r2 finding). Rows pad to one power-of-two bucket; the
+        # PADDED width counts against the budget since that is the device
+        # compute actually spent. NOTE: a preempted sequence re-prefills
+        # prompt+output together (num_tokens includes generated tokens).
+        n = len(cands)
+        while True:
+            rems = [c.num_tokens - c.num_computed_tokens for c in cands[:n]]
+            chunk_cap = min(max(rems), max(16, budget // n))
+            t_bucket = 16
+            while t_bucket < chunk_cap:
+                t_bucket *= 2
+            if n == 1 or n * t_bucket <= budget:
+                break
+            n -= 1
+        seqs = cands[:n]
+        # Candidates allocated THIS pass but dropped by the shrink loop must
+        # not sit in waiting pinning non-evictable blocks (they could starve
+        # decode's append_block under memory pressure); release them — the
+        # prefix cache makes the re-allocation next pass cheap.
+        for cand in cands[n:]:
+            if cand.request_id in newly_allocated:
+                self.block_manager.free_blocks(cand.block_ids)
+                cand.block_ids = []
+                cand.num_computed_tokens = 0
+                cand.num_cached_tokens = 0
+                cand._prev_hash = b""
+                cand._num_hashed_blocks = 0
+        starts = [s.num_computed_tokens for s in seqs]
+        lens = [
+            min(s.num_tokens - s.num_computed_tokens, chunk_cap) for s in seqs
+        ]
         for seq in seqs:
             self.waiting.remove(seq)
             seq.status = SequenceStatus.RUNNING

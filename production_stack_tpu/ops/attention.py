@@ -98,34 +98,38 @@ def window_attention(
     kc = k_chunk.transpose(2, 0, 1, 3)    # [Hkv, B, T, Dh]
     vc = v_chunk.transpose(2, 0, 1, 3)
 
-    # Additive mask biases, built once per (segment, row[, t]) — f32 {0,-inf}.
+    # Additive mask biases — f32 {0,-inf}. Per-(row,key) masks are small and
+    # built once; the per-(query,key) causal masks are built INSIDE each
+    # Q-block from the block's positions, so at most [B, QBLOCK, T] exists at
+    # a time (a precomputed [B, T, T] bias scanned as an xs operand costs
+    # 512 MiB of HBM at T=4096, B=8 — advisor r2 finding).
     neg = jnp.float32(_NEG_INF)
     t_idx = jnp.arange(t, dtype=jnp.int32)
     chunk_valid = t_idx[None, :] < chunk_lens[:, None]              # [B, T]
-    chunk_bias = jnp.where(
-        chunk_valid[:, None, :] & (positions[:, None, :] <= positions[:, :, None]),
-        0.0, neg,
-    )                                                               # [B, T(q), T(k)]
-    win_bias = ring_bias = None
+    win_bias = None
     if win_k is not None:
         s = win_k.shape[2]
         s_idx = jnp.arange(s, dtype=jnp.int32)
         win_bias = jnp.where(s_idx[None, :] < win_len[:, None], 0.0, neg)  # [B, S]
-    if ring_k is not None:
-        ring_bias = jnp.where(
-            ring_pos[:, None, :] < positions[:, :, None], 0.0, neg
-        )                                                           # [B, T, R]
 
-    def q_block(qb, cb, rb):
-        # qb: [Hkv, B, G, TQ, Dh]; cb: [B, TQ, T]; rb: [B, TQ, R] or None
+    def q_block(qb, pos_q):
+        # qb: [Hkv, B, G, TQ, Dh]; pos_q: [B, TQ] query positions
         tq = qb.shape[3]
         m = g * tq
         qb = qb.reshape(hkv, b, m, dh)
+        cb = jnp.where(
+            chunk_valid[:, None, :]
+            & (positions[:, None, :] <= pos_q[:, :, None]),
+            0.0, neg,
+        )                                                   # [B, TQ, T]
         segs = []
         if win_k is not None:
             sw = _seg_scores(qb, win_k)
             segs.append(sw + win_bias[None, :, None, :])
         if ring_k is not None:
+            rb = jnp.where(
+                ring_pos[:, None, :] < pos_q[:, :, None], 0.0, neg
+            )                                               # [B, TQ, R]
             sr = _seg_scores(qb, ring_k)
             rb4 = jnp.broadcast_to(
                 rb[:, None, :, :], (b, g, tq, rb.shape[-1])
@@ -149,26 +153,18 @@ def window_attention(
         return out.reshape(hkv, b, g, tq, dh)
 
     if t <= QBLOCK:
-        out = q_block(qf, chunk_bias, ring_bias)
+        out = q_block(qf, positions)
     else:
         assert t % QBLOCK == 0, "token bucket must be a multiple of QBLOCK"
         nb = t // QBLOCK
         qs = qf.reshape(hkv, b, g, nb, QBLOCK, dh).transpose(3, 0, 1, 2, 4, 5)
-        cbs = chunk_bias.reshape(b, nb, QBLOCK, t).transpose(1, 0, 2, 3)
-        rbs = (
-            ring_bias.reshape(b, nb, QBLOCK, -1).transpose(1, 0, 2, 3)
-            if ring_bias is not None else None
-        )
+        pos_qs = positions.reshape(b, nb, QBLOCK).transpose(1, 0, 2)
 
         def body(_, xs):
-            if rbs is None:
-                qb, cb = xs
-                return (), q_block(qb, cb, None)
-            qb, cb, rb = xs
-            return (), q_block(qb, cb, rb)
+            qb, pos_q = xs
+            return (), q_block(qb, pos_q)
 
-        xs = (qs, cbs) if rbs is None else (qs, cbs, rbs)
-        _, outs = jax.lax.scan(body, (), xs)               # [nb, Hkv,B,G,QB,Dh]
+        _, outs = jax.lax.scan(body, (), (qs, pos_qs))     # [nb, Hkv,B,G,QB,Dh]
         out = outs.transpose(1, 2, 3, 0, 4, 5).reshape(hkv, b, g, t, dh)
 
     # [Hkv, B, G, T, Dh] -> [B, T, H, Dh]

@@ -61,8 +61,11 @@ class APIServer:
         async def auth(request: web.Request, handler):
             if self.api_key and (request.path.startswith("/v1")
                                  or request.path == "/rerank"):
-                if request.headers.get("Authorization") != \
-                        f"Bearer {self.api_key}":
+                import hmac
+
+                got = request.headers.get("Authorization") or ""
+                want = f"Bearer {self.api_key}"
+                if not hmac.compare_digest(got.encode(), want.encode()):
                     return _error(401, "Invalid or missing API key",
                                   etype="authentication_error")
             return await handler(request)
@@ -110,8 +113,7 @@ class APIServer:
         return web.json_response({
             "object": "list",
             "data": [
-                {"object": "embedding", "index": i,
-                 "embedding": [float(x) for x in vec]}
+                {"object": "embedding", "index": i, "embedding": vec.tolist()}
                 for i, vec in enumerate(vecs)
             ],
             "model": self.model_name,

@@ -1,0 +1,114 @@
+"""Regression tests for the round-2 advisor findings.
+
+1. sampling: per-row filter selection — an unfiltered row's token must not
+   change when co-batched with a filtered row (determinism contract of
+   runner._token_seed).
+2. scheduler: a queue-head with a tiny remaining prefill tail must not cap
+   co-scheduled fresh prompts' chunk size.
+3. attention: the chunked prefill path must not materialize a [B, T, T] bias
+   (checked indirectly: chunked output still matches the one-shot path).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.kv_cache import BlockPoolManager
+from production_stack_tpu.engine.sampling import SamplingParams, sample_tokens
+from production_stack_tpu.engine.scheduler import Scheduler, Sequence
+
+
+def _sample(logits, temps, top_k, top_p, seeds):
+    return np.asarray(sample_tokens(
+        jnp.asarray(logits, jnp.float32),
+        jnp.asarray(temps, jnp.float32),
+        jnp.asarray(top_k, jnp.int32),
+        jnp.asarray(top_p, jnp.float32),
+        jnp.asarray(seeds, jnp.uint32),
+    ))
+
+
+def test_sampler_row_independent_of_batchmates():
+    rng = np.random.default_rng(0)
+    v = 1000
+    row = rng.normal(size=(v,)).astype(np.float32)
+    # Alone, unfiltered.
+    alone = _sample(row[None], [0.8], [-1], [1.0], [42])[0]
+    # Co-batched with a heavily filtered row.
+    other = rng.normal(size=(v,)).astype(np.float32)
+    batched = _sample(
+        np.stack([row, other]), [0.8, 0.7], [-1, 5], [1.0, 0.5], [42, 7]
+    )[0]
+    assert alone == batched
+
+    # And the filtered row is itself deterministic w.r.t. batch composition.
+    f_alone = _sample(other[None], [0.7], [5], [0.5], [7])[0]
+    f_batched = _sample(
+        np.stack([other, row]), [0.7, 0.8], [5, -1], [0.5, 1.0], [7, 42]
+    )[0]
+    assert f_alone == f_batched
+
+
+def test_sampler_top_k_respected_per_row():
+    rng = np.random.default_rng(1)
+    v = 512
+    logits = rng.normal(size=(2, v)).astype(np.float32)
+    top1 = np.argsort(logits[0])[-1]
+    # Row 0: top_k=1 must force the argmax; row 1 unfiltered.
+    for seed in range(20):
+        out = _sample(logits, [1.0, 1.0], [1, -1], [1.0, 1.0], [seed, seed])
+        assert out[0] == top1
+
+
+def test_prefill_chunk_not_capped_by_queue_head_tail():
+    cfg = EngineConfig(
+        model="tiny-llama", max_model_len=8192, block_size=16,
+        max_num_seqs=8, max_num_batched_tokens=4096, max_prefill_seqs=4,
+    )
+    bm = BlockPoolManager(1024, 16, enable_prefix_caching=False)
+    sched = Scheduler(cfg, bm)
+
+    head = Sequence("head", list(range(500)), SamplingParams())
+    fresh = Sequence("fresh", list(range(4000)), SamplingParams())
+    sched.add_sequence(head)
+    sched.add_sequence(fresh)
+
+    # First dispatch prefills both; simulate head having computed all but a
+    # 16-token tail, then reschedule.
+    batch = sched.schedule()
+    assert batch.kind == "prefill"
+    # Manufacture the mid-prefill state the advisor described: head has 16
+    # tokens left, fresh hasn't started.
+    head.num_computed_tokens = 484
+    fresh.num_computed_tokens = 0
+    sched.waiting.clear()
+    sched.waiting.extend([head, fresh])
+    head.status = fresh.status = head.status.WAITING
+    batch = sched.schedule()
+    assert batch.kind == "prefill"
+    lens = dict(zip([s.request_id for s in batch.seqs], batch.chunk_lens))
+    assert lens["head"] == 16
+    # The fresh prompt gets a fair share of the 4096 budget (>= 2048 with two
+    # rows), NOT the head's 16-token tail.
+    assert lens["fresh"] >= 2048
+
+
+def test_padded_width_counts_against_budget():
+    cfg = EngineConfig(
+        model="tiny-llama", max_model_len=8192, block_size=16,
+        max_num_seqs=8, max_num_batched_tokens=1024, max_prefill_seqs=8,
+    )
+    bm = BlockPoolManager(2048, 16, enable_prefix_caching=False)
+    sched = Scheduler(cfg, bm)
+    for i in range(8):
+        sched.add_sequence(
+            Sequence(f"s{i}", list(range(1000)), SamplingParams())
+        )
+    batch = sched.schedule()
+    assert batch.kind == "prefill"
+    # Each row pads to a power-of-two bucket; rows * bucket must fit 1024.
+    n = len(batch.seqs)
+    bucket = 16
+    while bucket < max(batch.chunk_lens):
+        bucket *= 2
+    assert n * bucket <= 1024
