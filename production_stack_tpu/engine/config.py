@@ -42,7 +42,15 @@ class EngineConfig:
     sequence_parallel_size: int = 1         # ring-attention axis for long prefill
     data_parallel_size: int = 1
     # --- kernels ---
-    attn_impl: str = "auto"                 # "auto" | "xla" | "pallas"
+    # "auto"   -> "paged" (Pallas flash-decode against the HBM pool, no window
+    #             copy) when the backend is a TPU and the model supports it
+    #             (llama family, head_dim % 128 == 0), else "window".
+    # "window" -> decode gathers the live KV into a contiguous per-dispatch
+    #             window ("xla" accepted as a legacy alias).
+    # "paged"  -> force the Pallas path ("pallas" accepted as an alias);
+    #             raises if the model/block size can't satisfy the kernel's
+    #             alignment constraints.
+    attn_impl: str = "auto"
     # --- KV offload (LMCache-equivalent; env names mirror the reference chart)
     kv_offload_cpu: bool = field(
         default_factory=lambda: os.environ.get("LMCACHE_LOCAL_CPU", "").lower() == "true"
@@ -71,11 +79,37 @@ class EngineConfig:
     # --- serving ---
     served_model_name: Optional[str] = None
 
-    def resolved_attn_impl(self) -> str:
-        if self.attn_impl != "auto":
-            return self.attn_impl
+    def resolved_attn_impl(self, model_config) -> str:
+        """Resolve the decode attention implementation for ``model_config``
+        (see the attn_impl field comment for the semantics)."""
+        from production_stack_tpu.ops.pallas.paged_attention import (
+            supports_pallas_decode,
+        )
+
+        supported = (
+            model_config.arch == "llama"
+            and supports_pallas_decode(model_config.head_dim_, self.block_size)
+        )
+        v = self.attn_impl
+        if v in ("xla", "window"):
+            return "window"
+        if v in ("pallas", "paged"):
+            if not supported:
+                raise ValueError(
+                    f"attn_impl={v!r} requires a llama-family model with "
+                    f"head_dim % 128 == 0 and SUPER_TOKENS-aligned block "
+                    f"size; got arch={model_config.arch} "
+                    f"head_dim={model_config.head_dim_} "
+                    f"block_size={self.block_size}"
+                )
+            return "paged"
+        if v != "auto":
+            raise ValueError(f"Unknown attn_impl {v!r}")
         import jax
-        return "pallas" if jax.default_backend() not in ("cpu",) else "xla"
+
+        return "paged" if (
+            supported and jax.default_backend() not in ("cpu",)
+        ) else "window"
 
     @property
     def model_name(self) -> str:

@@ -96,8 +96,11 @@ class ServingEngine:
                 remote_url=config.kv_remote_url,
                 serde=config.kv_remote_serde,
             )
-        self.scheduler = Scheduler(config, self.block_manager,
-                                   offload=self.offload)
+        self.scheduler = Scheduler(
+            config, self.block_manager, offload=self.offload,
+            decode_window_budget=self.runner.decode_window_blocks,
+            prefill_window_budget=self.runner.prefill_window_blocks,
+        )
 
         self._streams: Dict[str, _StreamState] = {}
         self._pending_aborts: Set[str] = set()

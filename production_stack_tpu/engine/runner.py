@@ -37,20 +37,12 @@ from production_stack_tpu.models.config import ModelConfig
 from production_stack_tpu.ops.attention import gather_window
 from production_stack_tpu.parallel import kv_pool_sharding, param_shardings
 from production_stack_tpu.parallel.mesh import Mesh
-from production_stack_tpu.utils import cdiv, init_logger
+from production_stack_tpu.utils import cdiv, init_logger, pow2_bucket as _bucket
 
 logger = init_logger(__name__)
 
 _SEED_MULT = np.uint32(1000003)
 _POS_SENTINEL = np.int32(2**30)  # ring_pos value for not-yet-written entries
-
-
-def _bucket(n: int, lo: int, hi: int) -> int:
-    """Smallest power-of-two >= n, clamped to [lo, hi]."""
-    b = lo
-    while b < n and b < hi:
-        b *= 2
-    return min(max(b, lo), hi)
 
 
 def _dtype(name: str):
@@ -108,9 +100,12 @@ class ModelRunner:
         self.config = config
         self.model_config = model_config
         self.mesh = mesh
-        self.attn_impl = "window"  # see module docstring; config.attn_impl is
-        # honored only as "xla"-family — the standalone pallas kernel remains
-        # available for direct use (ops/pallas/paged_attention.py).
+        # "paged": decode attends directly against the HBM pool inside the
+        # Pallas flash-decode kernel (no gathered window copy, pool not
+        # halved). "window": decode gathers the live KV into a contiguous
+        # per-dispatch window (models the kernel can't serve: head_dim < 128).
+        self.attn_impl = config.resolved_attn_impl(model_config)
+        self._pallas_interpret = jax.default_backend() in ("cpu",)
         self.dtype = _dtype(config.dtype)
         if config.compilation_cache_dir:
             _setup_compilation_cache(config.compilation_cache_dir)
@@ -189,14 +184,51 @@ class ModelRunner:
             pass
         if free_bytes is None:
             free_bytes = 2 << 30  # conservative default when unprobeable
-        # The decode window is a gathered copy of the live KV (up to
-        # max_num_seqs * max_blocks_per_seq blocks), so budget for pool +
-        # window rather than pool alone.
-        n = int(free_bytes * cfg.hbm_utilization) // (2 * bytes_per_block)
+        budget_blocks = int(free_bytes * cfg.hbm_utilization) // bytes_per_block
+        if self.attn_impl == "window":
+            # The decode window is a gathered copy of the live KV (up to the
+            # whole pool), so budget for pool + window rather than pool alone.
+            # The scheduler additionally caps each dispatch's bucketed
+            # rows x blocks window at pool size (window budgets below).
+            n = budget_blocks // 2
+        else:
+            # Paged decode never copies the pool, but chunked PREFILL still
+            # gathers a [rows, max_blocks] history window; reserve the
+            # worst-case bucketed prefill window out of the pool budget.
+            reserve = min(
+                _bucket(cfg.max_prefill_seqs, 1, max(1, cfg.max_num_seqs))
+                * _bucket(cfg.max_blocks_per_seq, 1,
+                          max(1, cfg.max_blocks_per_seq)),
+                budget_blocks // 2,
+            )
+            self._prefill_window_blocks = max(1, reserve)
+            n = budget_blocks - reserve
         n = max(2, min(n, cfg.max_blocks_per_seq * cfg.max_num_seqs + 1))
-        logger.info("KV pool: %d blocks x %d tokens (%.1f MiB)",
-                    n, cfg.block_size, n * bytes_per_block / (1 << 20))
+        logger.info("KV pool: %d blocks x %d tokens (%.1f MiB, attn=%s)",
+                    n, cfg.block_size, n * bytes_per_block / (1 << 20),
+                    self.attn_impl)
         return n
+
+    @property
+    def decode_window_blocks(self) -> int:
+        """Per-dispatch block budget for the DECODE gathered window: the
+        scheduler keeps bucket(rows) * bucket(max_blocks_per_row) under this
+        (a gathered window duplicates shared prefix blocks per row and pads
+        to power-of-two buckets, so it can exceed the LIVE pool bytes —
+        advisor r2 finding). Paged decode reads the pool in place: no cap."""
+        if self.attn_impl != "window":
+            return 1 << 30
+        return self.num_kv_blocks
+
+    @property
+    def prefill_window_blocks(self) -> int:
+        """Per-dispatch block budget for the PREFILL history window (both
+        impls gather it for chunks past the first)."""
+        if self.attn_impl == "window":
+            return self.num_kv_blocks
+        # Set by _derive_num_blocks; explicit num_kv_blocks configs skip the
+        # derivation, so fall back to the pool size.
+        return getattr(self, "_prefill_window_blocks", self.num_kv_blocks)
 
     # --------------------------------------------------------- device helpers
     def _derive_seeds(self, seed_base, gen0, j):
@@ -245,8 +277,16 @@ class ModelRunner:
             seed_base[None, :], gen0[None, :], k_iota[:, None]
         )
 
-        win_k, win_v = gather_window(kv_k, kv_v, block_tables, bs)
-        win_len = pos0                                           # [b]
+        if self.attn_impl == "paged":
+            # Decode attends directly against the stacked HBM pool inside
+            # the Pallas kernel — the live KV is never copied.
+            win_k = win_v = win_len = None
+            paged = (kv_k, kv_v, block_tables, pos0, bs,
+                     self._pallas_interpret)
+        else:
+            win_k, win_v = gather_window(kv_k, kv_v, block_tables, bs)
+            win_len = pos0                                       # [b]
+            paged = None
 
         nl, hkv, dh = mc.num_layers, mc.num_kv_heads, mc.head_dim_
         ring_k0 = jnp.zeros((nl, hkv, b, num_steps, dh), self.dtype)
@@ -262,6 +302,7 @@ class ModelRunner:
             hidden, k_new, v_new = self._forward(
                 params, mc, toks[:, None], positions, ones,
                 win_k, win_v, win_len, ring_k, ring_v, ring_pos,
+                paged=paged,
             )
             logits = self._logits_fn(params, mc, hidden[:, 0])
             nxt = sample_tokens(logits, temps, top_k, top_p, seeds_j)

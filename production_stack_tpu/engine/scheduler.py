@@ -25,7 +25,7 @@ from collections import deque
 from production_stack_tpu.engine.config import EngineConfig
 from production_stack_tpu.engine.kv_cache import BlockPoolManager
 from production_stack_tpu.engine.sampling import SamplingParams
-from production_stack_tpu.utils import init_logger
+from production_stack_tpu.utils import init_logger, pow2_bucket as _bucket
 
 logger = init_logger(__name__)
 
@@ -106,14 +106,30 @@ class ScheduledBatch:
 
 class Scheduler:
     def __init__(self, config: EngineConfig, block_manager: BlockPoolManager,
-                 offload=None):
+                 offload=None, decode_window_budget: Optional[int] = None,
+                 prefill_window_budget: Optional[int] = None):
         self.config = config
         self.block_manager = block_manager
         self.offload = offload  # KVOffloadManager (host/remote KV tiers)
+        # A dispatch with history gathers bucket(rows) x bucket(max_blocks)
+        # blocks into a contiguous window copy; cap that product so a batch
+        # of prefix-sharing long sequences can't materialize a window larger
+        # than the budgeted HBM (advisor r2). Decode under the paged impl
+        # reads the pool in place (no window): budget None = unlimited.
+        self.decode_window_budget = decode_window_budget or (1 << 30)
+        self.prefill_window_budget = prefill_window_budget or (1 << 30)
         self.waiting: Deque[Sequence] = deque()
         self.running: List[Sequence] = []
         self.seqs: Dict[str, Sequence] = {}
         self.num_preemptions_total = 0
+
+    def _window_ok(self, rows: int, max_blocks: int, budget: int) -> bool:
+        cfg = self.config
+        return (
+            _bucket(rows, 1, max(1, cfg.max_num_seqs))
+            * _bucket(max_blocks, 1, max(1, cfg.max_blocks_per_seq))
+            <= budget
+        )
 
     # ----------------------------------------------------------------- intake
     def add_sequence(self, seq: Sequence) -> None:
@@ -218,7 +234,14 @@ class Scheduler:
             t_bucket = 16
             while t_bucket < chunk_cap:
                 t_bucket *= 2
-            if n == 1 or n * t_bucket <= budget:
+            # A chunk with history gathers a [rows, max_blocks] window; keep
+            # its bucketed size within the window budget too.
+            has_window = any(c.num_computed_tokens > 0 for c in cands[:n])
+            mb_need = max(len(c.block_ids) for c in cands[:n])
+            win_ok = not has_window or self._window_ok(
+                n, mb_need, self.prefill_window_budget
+            )
+            if n == 1 or (n * t_bucket <= budget and win_ok):
                 break
             n -= 1
         seqs = cands[:n]
@@ -285,6 +308,13 @@ class Scheduler:
             avail = len(seq.block_ids) * bs - pos
             if avail <= 0:
                 continue
+            mb_next = max(
+                [len(seq.block_ids)] + [len(s.block_ids) for s in scheduled]
+            )
+            if scheduled and not self._window_ok(
+                len(scheduled) + 1, mb_next, self.decode_window_budget
+            ):
+                continue  # window budget full; this row decodes next dispatch
             scheduled.append(seq)
             steps.append(min(want, avail))
         if not scheduled:

@@ -125,10 +125,30 @@ LLAMA3_8B = ModelConfig(
     rope_theta=500000.0, name="meta-llama/Meta-Llama-3-8B",
 )
 
+# meta-llama/Llama-3.2-3B architecture: head_dim 128, so the Pallas paged
+# flash-decode kernel applies, and the bf16 weights (~6.4 GB) fit a single
+# v5e chip — the single-chip long-context (paged attention) benchmark model.
+LLAMA32_3B = ModelConfig(
+    arch="llama", vocab_size=128256, hidden_size=3072, intermediate_size=8192,
+    num_layers=28, num_heads=24, num_kv_heads=8, head_dim=128,
+    max_position_embeddings=131072, rope_theta=500000.0,
+    tie_word_embeddings=True, name="llama-3b",
+)
+
+# Tiny config with head_dim 128 so CPU tests can exercise the Pallas paged
+# decode path (interpret mode) end-to-end.
+TINY_LLAMA_128DH = ModelConfig(
+    arch="llama", vocab_size=512, hidden_size=256, intermediate_size=512,
+    num_layers=2, num_heads=2, num_kv_heads=2, head_dim=128,
+    max_position_embeddings=512, name="tiny-llama-128dh",
+)
+
 NAMED_CONFIGS = {
     "tiny-llama": TINY_LLAMA,
     "tiny-llama-8kv": TINY_LLAMA_8KV,
+    "tiny-llama-128dh": TINY_LLAMA_128DH,
     "llama-1b": LLAMA_1B,
+    "llama-3b": LLAMA32_3B,
     "facebook/opt-125m": OPT_125M,
     "meta-llama/Meta-Llama-3-8B": LLAMA3_8B,
     "llama-3-8b": LLAMA3_8B,

@@ -22,7 +22,11 @@ import jax
 import jax.numpy as jnp
 
 from production_stack_tpu.models.config import ModelConfig
-from production_stack_tpu.ops.attention import window_attention
+from production_stack_tpu.ops.attention import (
+    dense_decode_stats,
+    merge_attention_segments,
+    window_attention,
+)
 
 Params = Dict
 
@@ -95,6 +99,8 @@ def _layer_body(
     chunk_lens: jax.Array,
     win_k, win_v, win_len,
     ring_k, ring_v, ring_pos,
+    paged=None,               # (pool_k, pool_v, block_tables, kv_lens,
+    layer_idx=None,           #  block_size, interpret) + scan layer index
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     b, t, d = hidden.shape
     h, hkv, dh = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
@@ -113,10 +119,41 @@ def _layer_body(
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
-    attn = window_attention(
-        q, k, v, positions, chunk_lens,
-        win_k, win_v, win_len, ring_k, ring_v, ring_pos,
-    )
+    if paged is not None:
+        # Paged decode (T == 1): the pool segment runs in the Pallas
+        # flash-decode kernel directly against this layer of the stacked HBM
+        # pool (no gathered window copy); the intra-dispatch ring + the
+        # current token form a small dense segment; the two merge by their
+        # softmax stats. See ops/pallas/paged_attention.py.
+        from production_stack_tpu.ops.pallas.paged_attention import (
+            paged_flash_decode_stats,
+        )
+
+        pool_k, pool_v, block_tables, kv_lens, block_size, interpret = paged
+        q2 = q.reshape(b, h, dh)
+        out_p, m_p, l_p = paged_flash_decode_stats(
+            q2, pool_k, pool_v, block_tables, kv_lens, layer_idx,
+            block_size=block_size, interpret=interpret,
+        )
+        kc = k.transpose(2, 0, 1, 3)          # [Hkv, B, 1, Dh] current token
+        vc = v.transpose(2, 0, 1, 3)
+        self_bias = jnp.zeros((b, 1), jnp.float32)
+        if ring_k is not None:
+            keys = jnp.concatenate([ring_k, kc], axis=2)
+            vals = jnp.concatenate([ring_v, vc], axis=2)
+            neg = jnp.float32(jnp.finfo(jnp.float32).min)
+            ring_bias = jnp.where(ring_pos < positions, 0.0, neg)  # [B, R]
+            bias = jnp.concatenate([ring_bias, self_bias], axis=1)
+        else:
+            keys, vals, bias = kc, vc, self_bias
+        out_d, m_d, l_d = dense_decode_stats(q2, keys, vals, bias)
+        attn = merge_attention_segments(out_p, m_p, l_p, out_d, m_d, l_d)
+        attn = attn.reshape(b, t, h, dh)
+    else:
+        attn = window_attention(
+            q, k, v, positions, chunk_lens,
+            win_k, win_v, win_len, ring_k, ring_v, ring_pos,
+        )
     hidden = hidden + attn.reshape(b, t, h * dh) @ lp["wo"]
 
     x = rms_norm(hidden, lp["mlp_norm"], cfg.rms_norm_eps)
@@ -139,11 +176,16 @@ def forward(
     ring_pos: Optional[jax.Array] = None,  # [B, R]
     *,
     act_sharding=None,
+    paged=None,  # (pool_k [L,Hkv,S,Dh], pool_v, block_tables [B,Mb],
+                 #  kv_lens [B], block_size, interpret) — paged decode path
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Returns (hidden [B,T,D], k_new [L,Hkv,B,T,Dh], v_new [L,Hkv,B,T,Dh]).
 
-    The caller owns the paged pool: it gathers the window before this call and
-    scatters (k_new, v_new) into the pool after (see engine/runner.py).
+    The caller owns the paged pool. Window path: it gathers the window before
+    this call and scatters (k_new, v_new) into the pool after (see
+    engine/runner.py). Paged path (``paged`` set, decode only): each layer
+    attends directly against its slice of the stacked HBM pool inside the
+    Pallas flash-decode kernel — no window copy exists.
 
     ``act_sharding``: optional NamedSharding P(None, "sp", None) — prefill
     chunks shard the TOKEN axis over the sequence-parallel mesh axis so the
@@ -161,19 +203,24 @@ def forward(
 
     have_win = win_k is not None
     have_ring = ring_k is not None
+    have_paged = paged is not None
 
     def scan_fn(h_carry, xs):
         lp = xs[0]
         i = 1
-        wk = wv = rk = rv = None
+        wk = wv = rk = rv = li = None
         if have_win:
             wk, wv = xs[i], xs[i + 1]
             i += 2
         if have_ring:
             rk, rv = xs[i], xs[i + 1]
+            i += 2
+        if have_paged:
+            li = xs[i]
         h_out, k_l, v_l = _layer_body(
             cfg, h_carry, lp, cos, sin, positions, chunk_lens,
             wk, wv, win_len, rk, rv, ring_pos,
+            paged=paged, layer_idx=li,
         )
         return h_out, (k_l, v_l)
 
@@ -182,6 +229,8 @@ def forward(
         xs += (win_k, win_v)
     if have_ring:
         xs += (ring_k, ring_v)
+    if have_paged:
+        xs += (jnp.arange(cfg.num_layers, dtype=jnp.int32),)
     hidden, (k_new, v_new) = jax.lax.scan(scan_fn, hidden, xs)
     hidden = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
     return hidden, k_new, v_new

@@ -171,6 +171,63 @@ def window_attention(
     return out.transpose(1, 3, 0, 2, 4).reshape(b, t, h, dh).astype(q.dtype)
 
 
+def dense_decode_stats(
+    q: jax.Array,         # [B, H, Dh] decode queries (post-rope, UNscaled)
+    keys: jax.Array,      # [Hkv, B, S, Dh]
+    values: jax.Array,    # [Hkv, B, S, Dh]
+    bias: jax.Array,      # [B, S] additive f32 {0, -inf} validity mask
+    *,
+    scale: Optional[float] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Flash-style stats for a small dense key segment (decode T == 1).
+
+    Used for the intra-dispatch ring + current-token segment when the pool
+    segment runs in the Pallas kernel (paged_flash_decode_stats). Returns
+    (out [B, H, Dh] normalized, m [B, H] f32, l [B, H] f32); a row whose bias
+    masks ALL keys returns (0, -inf, 0) — a no-op under merge.
+    """
+    b, h, dh = q.shape
+    hkv = keys.shape[0]
+    g = h // hkv
+    if scale is None:
+        scale = dh ** -0.5
+    qf = (q.astype(jnp.float32) * scale).astype(q.dtype)
+    qf = qf.reshape(b, hkv, g, dh).transpose(1, 0, 2, 3)  # [Hkv, B, G, Dh]
+    scores = _seg_scores(qf, keys) + bias[None, :, None, :]  # [Hkv, B, G, S]
+    m = jnp.max(scores, axis=-1)                             # [Hkv, B, G]
+    # In a fully-masked row every score equals the mask bias, so
+    # exp(score - m) would be exp(0) = 1; mask p explicitly (real scores are
+    # tiny against _NEG_INF, so the threshold is unambiguous).
+    p = jnp.exp(scores - m[..., None])
+    p = jnp.where(scores > jnp.float32(_NEG_INF) / 2, p, 0.0)
+    l = jnp.sum(p, axis=-1)                                  # [Hkv, B, G]
+    out = _seg_pv(p, values)                                 # [Hkv, B, G, Dh]
+    out = out / jnp.maximum(l, 1e-30)[..., None]
+    out = out.transpose(1, 0, 2, 3).reshape(b, h, dh).astype(q.dtype)
+    mt = jnp.where(l > 0, m, -jnp.inf)
+    return out, mt.transpose(1, 0, 2).reshape(b, h), \
+        l.transpose(1, 0, 2).reshape(b, h)
+
+
+def merge_attention_segments(
+    out_a: jax.Array, m_a: jax.Array, l_a: jax.Array,   # [B,H,Dh],[B,H],[B,H]
+    out_b: jax.Array, m_b: jax.Array, l_b: jax.Array,
+) -> jax.Array:
+    """Flash-merge two NORMALIZED attention segments with their softmax stats
+    into the attention over the union of their keys. Safe when one segment is
+    empty (m = -inf, l = 0); at least one segment must have a valid key."""
+    m = jnp.maximum(m_a, m_b)
+    m = jnp.maximum(m, jnp.float32(_NEG_INF))  # both-empty guard
+    wa = l_a * jnp.exp(m_a - m)
+    wb = l_b * jnp.exp(m_b - m)
+    denom = jnp.maximum(wa + wb, 1e-30)
+    out = (
+        out_a.astype(jnp.float32) * (wa / denom)[..., None]
+        + out_b.astype(jnp.float32) * (wb / denom)[..., None]
+    )
+    return out.astype(out_a.dtype)
+
+
 def gather_window(
     kv_k: jax.Array,          # [L, Hkv, num_slots, Dh]
     kv_v: jax.Array,

@@ -406,7 +406,7 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--data-parallel-size", type=int, default=1)
     p.add_argument("--num-decode-steps", type=int, default=8)
     p.add_argument("--attn-impl", default="auto",
-                   choices=["auto", "xla", "pallas"])
+                   choices=["auto", "window", "paged", "xla", "pallas"])
     p.add_argument("--no-warmup", action="store_true",
                    help="Skip AOT warmup compilation at startup")
     import os

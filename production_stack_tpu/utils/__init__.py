@@ -3,6 +3,7 @@ from production_stack_tpu.utils.misc import (
     SingletonMeta,
     SingletonABCMeta,
     cdiv,
+    pow2_bucket,
     round_up,
     parse_comma_separated,
     parse_static_model_names,
@@ -17,6 +18,7 @@ __all__ = [
     "SingletonMeta",
     "SingletonABCMeta",
     "cdiv",
+    "pow2_bucket",
     "round_up",
     "parse_comma_separated",
     "parse_static_model_names",

@@ -41,6 +41,18 @@ def cdiv(a: int, b: int) -> int:
     return -(-a // b)
 
 
+def pow2_bucket(n: int, lo: int, hi: int) -> int:
+    """Smallest power-of-two >= n, clamped to [lo, hi].
+
+    THE shape-bucketing rule: the runner's dispatch shapes and the
+    scheduler's window-budget estimates must agree on it, so both import
+    this single definition."""
+    b = lo
+    while b < n and b < hi:
+        b *= 2
+    return min(max(b, lo), hi)
+
+
 def round_up(x: int, multiple: int) -> int:
     return cdiv(x, multiple) * multiple
 

@@ -1,0 +1,60 @@
+"""Smoke the Pallas flash-decode kernel on the real TPU and compare to XLA.
+Run: PYTHONPATH=/root/.axon_site:/root/repo python scripts/test_pallas_tpu.py
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from production_stack_tpu.ops.attention import paged_attention_xla
+from production_stack_tpu.ops.pallas.paged_attention import (
+    paged_attention_decode_pallas,
+)
+
+
+def trial(dh, hkv=8, g=4, b=16, s=1024, bs=16, dtype=jnp.bfloat16):
+    h = hkv * g
+    nslots = b * s + bs
+    mb = s // bs
+    key = jax.random.PRNGKey(0)
+    k1, k2, k3 = jax.random.split(key, 3)
+    q = jax.random.normal(k1, (b, 1, h, dh), dtype)
+    kp = jax.random.normal(k2, (hkv, nslots, dh), dtype)
+    vp = jax.random.normal(k3, (hkv, nslots, dh), dtype)
+    bt = np.zeros((b, mb), np.int32)
+    for i in range(b):
+        bt[i] = np.arange(1 + i * mb, 1 + (i + 1) * mb)
+    bt = jnp.asarray(bt)
+    lens = jnp.full((b,), s, jnp.int32)
+    pos = jnp.full((b, 1), s - 1, jnp.int32)
+
+    ref = paged_attention_xla(q, kp, vp, bt, lens, pos, block_size=bs)
+    try:
+        out = paged_attention_decode_pallas(q, kp, vp, bt, lens, block_size=bs)
+        out.block_until_ready()
+    except Exception as e:  # noqa: BLE001
+        print(f"dh={dh}: PALLAS FAILED: {type(e).__name__}: {str(e)[:300]}")
+        return
+    err = jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32)))
+    # timing
+    for fn, name in ((paged_attention_decode_pallas, "pallas"),):
+        t0 = time.perf_counter()
+        for _ in range(20):
+            o = fn(q, kp, vp, bt, lens, block_size=bs)
+        o.block_until_ready()
+        ms = (time.perf_counter() - t0) / 20 * 1000
+        kvb = 2 * hkv * b * s * dh * 2
+        print(f"dh={dh} {name}: max_err={float(err):.4f} {ms:.2f} ms "
+              f"({kvb/ms*1e3/2**30:.0f} GiB/s)")
+    t0 = time.perf_counter()
+    for _ in range(20):
+        o = paged_attention_xla(q, kp, vp, bt, lens, pos, block_size=bs)
+    o.block_until_ready()
+    ms = (time.perf_counter() - t0) / 20 * 1000
+    print(f"dh={dh} xla-gather: {ms:.2f} ms")
+
+
+if __name__ == "__main__":
+    trial(128)
+    trial(64)
