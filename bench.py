@@ -1,13 +1,17 @@
-"""Serving-engine throughput benchmark (single chip).
+"""Stack-level throughput benchmark (single chip).
 
-Workload mirrors the reference's multi-round-qa harness shape
-(reference benchmarks/multi-round-qa/multi-round-qa.py:435-512: concurrent
-user sessions, shared system prompt, streaming completions; metrics = output
-tokens/sec + TTFT). Here it drives the in-process engine on ONE chip — the
-driver runs this on real TPU hardware.
+Default mode measures the stack AS A STACK: it launches the engine API
+server and the router as subprocesses (benchmarks/stack.py) and drives the
+ROUTER's OpenAI endpoint with concurrent multi-round user sessions over
+streaming HTTP with the session-affinity header
+(benchmarks/multi_round_qa.py) — the same deployment shape and metric
+definitions as the reference harness (reference
+benchmarks/multi-round-qa/multi-round-qa.py:117-177,435-512; procedure
+tutorials/07-benchmark-multi-round-qa-single-gpu.md). ``--mode engine``
+keeps the old in-process engine drive for kernel-level iteration.
 
 Prints exactly one JSON line:
-    {"metric": ..., "value": N, "unit": "tok/s", "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": "tok/s", "vs_baseline": N, ...}
 
 The reference repo publishes no absolute numbers (BASELINE.md), so
 ``vs_baseline`` reports the fraction of the chip's HBM-bandwidth decode
@@ -30,6 +34,72 @@ import time
 PEAK_HBM_GBS = float(os.environ.get("PSTPU_PEAK_HBM_GBS", 819.0))
 
 
+def _roofline_tok_s(model: str, dtype_bytes: float, batch: int,
+                    avg_ctx: float) -> float:
+    """Aggregate decode roofline from the model's analytic byte counts."""
+    from production_stack_tpu.models.config import resolve_model_config
+
+    mc = resolve_model_config(model)
+    d, f, v = mc.hidden_size, mc.intermediate_size, mc.vocab_size
+    dh, h, hkv, nl = mc.head_dim_, mc.num_heads, mc.num_kv_heads, mc.num_layers
+    per_layer = d * (h * dh) + 2 * d * (hkv * dh) + (h * dh) * d + 3 * d * f
+    embed = v * d * (1 if mc.tie_word_embeddings else 2)
+    param_bytes = (nl * per_layer + embed) * dtype_bytes
+    kv_bytes_per_tok = 2 * nl * hkv * dh * dtype_bytes * avg_ctx
+    return PEAK_HBM_GBS * 1e9 / (param_bytes / batch + kv_bytes_per_tok)
+
+
+# --------------------------------------------------------------- stack mode
+def bench_stack(args) -> dict:
+    from benchmarks.multi_round_qa import (
+        WorkloadConfig,
+        run_workload,
+        summarize,
+    )
+    from benchmarks.stack import launch_stack
+
+    stack = launch_stack(
+        args.model,
+        engine_args=[
+            "--max-model-len", str(args.max_model_len),
+            "--max-num-seqs", str(max(8, args.users)),
+        ],
+        routing_logic="session",
+        router_args=["--session-key", "x-user-id"],
+    )
+    try:
+        cfg = WorkloadConfig(
+            base_url=stack.router_url,
+            model=args.model,
+            num_users=args.users,
+            num_rounds=args.rounds,
+            system_prompt_words=args.prompt_len,
+            answer_tokens=args.max_tokens,
+        )
+        # Warmup: the same shapes as the measurement so every bucket the
+        # timed region hits (prefill chunks, the fused decode scan) is
+        # compiled before timing starts.
+        warm = WorkloadConfig(**{**cfg.__dict__, "num_rounds": 2})
+        asyncio.run(run_workload(warm))
+        records = asyncio.run(run_workload(cfg))
+    finally:
+        stack.terminate()
+    summary = summarize(records)
+    if not summary.get("finished_requests"):
+        raise RuntimeError(
+            "stack benchmark finished zero requests — check the subprocess "
+            f"logs: {stack.log_paths}"
+        )
+    avg_prompt = summary["total_prompt_tokens"] / summary["finished_requests"]
+    return {
+        "metric": f"stack_output_throughput_{args.model}_1chip",
+        "value": round(summary["output_tokens_per_s"], 2),
+        "summary": summary,
+        "avg_prompt_tokens": avg_prompt,
+    }
+
+
+# -------------------------------------------------------------- engine mode
 async def _run_session(engine, sampling, prompt, ttfts, prompt_toks=None):
     start = time.monotonic()
     first = None
@@ -45,24 +115,18 @@ async def _run_session(engine, sampling, prompt, ttfts, prompt_toks=None):
     return n_out
 
 
-async def _bench(engine, n_users, rounds, prompt_len, max_tokens):
+async def _bench_engine(engine, n_users, rounds, prompt_len, max_tokens):
     from production_stack_tpu.engine.sampling import SamplingParams
 
     system = "You are a helpful assistant. " * max(1, prompt_len // 30)
     sampling = SamplingParams(
         temperature=0.0, max_tokens=max_tokens, ignore_eos=True
     )
-
-    # Warmup: full concurrent rounds with the SAME max_tokens as the timed
-    # rounds, so every shape bucket the measurement hits (prefill chunks,
-    # decode batch buckets, the full fused-decode scan length) compiles
-    # outside the timed region — a warmup at a smaller max_tokens leaves the
-    # measured decode scan shape cold and its multi-second XLA compile lands
-    # inside the timing (this was most of the round-2 number). Prompt tails
-    # are distinct from measured rounds so only the (intentionally) shared
-    # system prefix is warm in the prefix cache, as in the reference workload.
+    # Warmup at the SAME max_tokens as the timed rounds (a warmup at smaller
+    # max_tokens leaves the measured decode scan shape cold and its
+    # multi-second XLA compile lands inside the timing).
     ttfts = []
-    for w in range(2):  # pass 2 hits the prefix cache -> short-chunk shapes
+    for w in range(2):
         await asyncio.gather(*[
             _run_session(
                 engine, sampling,
@@ -99,8 +163,47 @@ async def _bench(engine, n_users, rounds, prompt_len, max_tokens):
     }
 
 
+def bench_engine(args) -> dict:
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.engine import ServingEngine
+
+    import jax
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    cfg = EngineConfig(
+        model=args.model,
+        max_model_len=args.max_model_len,
+        block_size=16,
+        max_num_seqs=max(8, args.users),
+        max_num_batched_tokens=1024,
+        num_kv_blocks=None if on_tpu else 2048,
+    )
+    engine = ServingEngine(cfg)
+
+    async def run():
+        await engine.start()
+        try:
+            return await _bench_engine(
+                engine, args.users, args.rounds, args.prompt_len,
+                args.max_tokens,
+            )
+        finally:
+            await engine.stop()
+
+    res = asyncio.run(run())
+    return {
+        "metric": f"engine_output_throughput_{args.model}_1chip",
+        "value": round(res["output_tok_s"], 2),
+        "summary": res,
+        "avg_prompt_tokens": res["avg_prompt_tokens"],
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", choices=["stack", "engine"], default="stack",
+                    help="stack: HTTP through router+engine subprocesses "
+                         "(the recorded configuration); engine: in-process")
     ap.add_argument("--model", default=None,
                     help="named model config (default: llama-1b on TPU, "
                          "tiny-llama on CPU)")
@@ -114,67 +217,41 @@ def main():
     ap.add_argument("--max-model-len", type=int, default=8192)
     args = ap.parse_args()
 
-    import jax
+    # Probe the backend in a SUBPROCESS: in stack mode the parent must not
+    # initialize the device client — the engine subprocess owns the chip.
+    import subprocess
 
-    on_tpu = jax.default_backend() not in ("cpu",)
-    model = args.model or ("llama-1b" if on_tpu else "tiny-llama")
+    backend = subprocess.run(
+        [sys.executable, "-c", "import jax; print(jax.default_backend())"],
+        capture_output=True, text=True, timeout=120,
+    ).stdout.strip() or "cpu"
+    on_tpu = backend not in ("", "cpu")
+    args.model = args.model or ("llama-1b" if on_tpu else "tiny-llama")
 
-    from production_stack_tpu.engine.config import EngineConfig
-    from production_stack_tpu.engine.engine import ServingEngine
+    res = bench_stack(args) if args.mode == "stack" else bench_engine(args)
+    summary = res["summary"]
 
-    cfg = EngineConfig(
-        model=model,
-        max_model_len=args.max_model_len,
-        block_size=16,
-        max_num_seqs=max(8, args.users),
-        max_num_batched_tokens=1024,
-        num_kv_blocks=None if on_tpu else 2048,
-    )
-    engine = ServingEngine(cfg)
-
-    async def run():
-        await engine.start()
-        try:
-            return await _bench(
-                engine, args.users, args.rounds, args.prompt_len,
-                args.max_tokens,
-            )
-        finally:
-            await engine.stop()
-
-    res = asyncio.run(run())
-
-    # Decode roofline: tokens/sec if HBM bandwidth were the only cost (every
-    # weight byte + the row's live KV streamed once per token).
-    param_bytes = sum(
-        x.size * x.dtype.itemsize for x in jax.tree.leaves(engine.runner.params)
-    )
-    mc = engine.model_config
-    # Context in TOKENS as measured (the --prompt-len arg is a rough word
-    # budget for prompt construction, not a token count).
     avg_ctx = res["avg_prompt_tokens"] + args.max_tokens / 2
-    import jax.numpy as jnp
-
-    kv_itemsize = jnp.dtype(engine.runner.dtype).itemsize
-    kv_bytes_per_tok = (
-        2 * mc.num_layers * mc.num_kv_heads * mc.head_dim_ * kv_itemsize
-        * avg_ctx
-    )
-    batch = max(1, args.users)
-    roofline_tok_s = (
-        PEAK_HBM_GBS * 1e9 / (param_bytes / batch + kv_bytes_per_tok)
-    )
-    print(json.dumps({
-        "metric": f"engine_output_throughput_{model}_1chip",
-        "value": round(res["output_tok_s"], 2),
+    roofline = _roofline_tok_s(args.model, 2.0, max(1, args.users), avg_ctx)
+    out = {
+        "metric": res["metric"],
+        "value": res["value"],
         "unit": "tok/s",
-        "vs_baseline": round(res["output_tok_s"] / roofline_tok_s, 3),
-        "roofline_tok_s": round(roofline_tok_s, 1),
-        "hbm_bw_pct": round(100 * res["output_tok_s"] / roofline_tok_s, 1),
-        "p50_ttft_s": round(res["p50_ttft_s"], 4) if res["p50_ttft_s"] else None,
-        "total_output_tokens": res["total_output_tokens"],
-        "backend": jax.default_backend(),
-    }))
+        "vs_baseline": round(res["value"] / roofline, 3),
+        "roofline_tok_s": round(roofline, 1),
+        "hbm_bw_pct": round(100 * res["value"] / roofline, 1),
+        "p50_ttft_s": round(summary["p50_ttft_s"], 4)
+        if summary.get("p50_ttft_s") else None,
+        "total_output_tokens": summary["total_output_tokens"],
+        "backend": backend,
+    }
+    if args.mode == "stack":
+        out.update({
+            "qps": round(summary["qps"], 3),
+            "input_tok_s": round(summary["input_tokens_per_s"], 1),
+            "avg_ttft_s": round(summary["avg_ttft_s"], 4),
+        })
+    print(json.dumps(out))
     return 0
 
 

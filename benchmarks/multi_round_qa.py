@@ -1,0 +1,225 @@
+"""Multi-round QA load harness over HTTP — the stack-level benchmark.
+
+Drives an OpenAI-compatible endpoint (normally the ROUTER, so the full
+router -> engine -> SSE-relay path is measured) with N concurrent user
+sessions: a shared system prompt, per-user growing chat history, streaming
+chat completions with the session-affinity header, TTFT measured at the
+first content chunk, token counts taken from the final usage chunk
+(``stream_options.include_usage``).
+
+Metric definitions mirror the reference harness
+(reference benchmarks/multi-round-qa/multi-round-qa.py:117-177 request
+execution, :435-512 ProcessSummary): QPS, processing speed (finished
+requests/s), input tokens/s, output tokens/s, per-request generation speed,
+average + p50 TTFT. The implementation is independent (asyncio + aiohttp,
+no pandas/openai-client dependency).
+
+CLI:
+    python -m benchmarks.multi_round_qa --base-url http://localhost:8000 \
+        --model llama-1b --num-users 16 --num-rounds 4 --answer-tokens 64
+"""
+
+import argparse
+import asyncio
+import json
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import aiohttp
+
+_WORDS = (
+    "the quick brown fox jumps over a lazy dog while curious engineers "
+    "measure throughput latency and cache behavior of serving stacks"
+).split()
+
+
+def synth_text(num_words: int, seed: int = 0) -> str:
+    """Deterministic filler text of ~num_words words."""
+    n = len(_WORDS)
+    return " ".join(_WORDS[(seed + i) % n] for i in range(max(1, num_words)))
+
+
+@dataclass
+class WorkloadConfig:
+    base_url: str = "http://localhost:8000"
+    model: str = "llama-1b"
+    num_users: int = 16
+    num_rounds: int = 4
+    system_prompt_words: int = 120
+    question_words: int = 12
+    answer_tokens: int = 64
+    gap_between_users_s: float = 0.0
+    session_header: str = "x-user-id"
+    api_key: Optional[str] = None
+    timeout_s: float = 300.0
+
+
+@dataclass
+class RequestRecord:
+    user: int
+    round: int
+    launch_time: float
+    ttft: float
+    finish_time: float
+    prompt_tokens: int
+    generation_tokens: int
+
+    @property
+    def generation_time(self) -> float:
+        return max(self.finish_time - self.launch_time - self.ttft, 1e-9)
+
+
+class UserSession:
+    """One user: shared system prompt + growing per-user history, one
+    streaming request per round through the session-affinity header."""
+
+    def __init__(self, cfg: WorkloadConfig, user_id: int, system_prompt: str):
+        self.cfg = cfg
+        self.user_id = user_id
+        self.messages = [{"role": "system", "content": system_prompt}]
+        self.records: List[RequestRecord] = []
+
+    async def _one_round(self, http: aiohttp.ClientSession, rnd: int) -> None:
+        cfg = self.cfg
+        question = (
+            f"user {self.user_id} round {rnd}: "
+            + synth_text(cfg.question_words, seed=self.user_id * 31 + rnd)
+        )
+        self.messages.append({"role": "user", "content": question})
+        headers = {cfg.session_header: f"user-{self.user_id}"}
+        if cfg.api_key:
+            headers["Authorization"] = f"Bearer {cfg.api_key}"
+        body = {
+            "model": cfg.model,
+            "messages": self.messages,
+            "temperature": 0,
+            "max_tokens": cfg.answer_tokens,
+            "ignore_eos": True,
+            "stream": True,
+            "stream_options": {"include_usage": True},
+        }
+        launch = time.monotonic()
+        first: Optional[float] = None
+        answer = ""
+        prompt_tokens = generation_tokens = 0
+        async with http.post(
+            f"{cfg.base_url}/v1/chat/completions", json=body, headers=headers,
+        ) as resp:
+            resp.raise_for_status()
+            async for raw in resp.content:
+                line = raw.decode("utf-8", "replace").strip()
+                if not line.startswith("data:"):
+                    continue
+                payload = line[len("data:"):].strip()
+                if payload == "[DONE]":
+                    break
+                chunk = json.loads(payload)
+                usage = chunk.get("usage")
+                if usage:
+                    prompt_tokens = usage.get("prompt_tokens", 0)
+                    generation_tokens = usage.get("completion_tokens", 0)
+                for choice in chunk.get("choices", []):
+                    delta = (choice.get("delta") or {}).get("content")
+                    if delta:
+                        if first is None:
+                            first = time.monotonic()
+                        answer += delta
+        finish = time.monotonic()
+        self.messages.append({"role": "assistant", "content": answer})
+        self.records.append(RequestRecord(
+            user=self.user_id, round=rnd, launch_time=launch,
+            ttft=(first if first is not None else finish) - launch,
+            finish_time=finish, prompt_tokens=prompt_tokens,
+            generation_tokens=generation_tokens,
+        ))
+
+    async def run(self, http: aiohttp.ClientSession, start_delay: float):
+        if start_delay > 0:
+            await asyncio.sleep(start_delay)
+        for rnd in range(self.cfg.num_rounds):
+            await self._one_round(http, rnd)
+
+
+async def run_workload(cfg: WorkloadConfig) -> List[RequestRecord]:
+    system_prompt = (
+        "You are a helpful, knowledgeable assistant serving many users. "
+        + synth_text(cfg.system_prompt_words)
+    )
+    sessions = [
+        UserSession(cfg, u, system_prompt) for u in range(cfg.num_users)
+    ]
+    timeout = aiohttp.ClientTimeout(total=cfg.timeout_s)
+    conn = aiohttp.TCPConnector(limit=0)
+    async with aiohttp.ClientSession(timeout=timeout, connector=conn) as http:
+        await asyncio.gather(*[
+            s.run(http, u * cfg.gap_between_users_s)
+            for u, s in enumerate(sessions)
+        ])
+    return [r for s in sessions for r in s.records]
+
+
+def summarize(records: List[RequestRecord]) -> dict:
+    """ProcessSummary-equivalent (reference multi-round-qa.py:435-512)."""
+    if not records:
+        return {"finished_requests": 0}
+    start = min(r.launch_time for r in records)
+    end = max(r.finish_time for r in records)
+    total_time = max(end - start, 1e-9)
+    ttfts = sorted(r.ttft for r in records)
+    gen_tokens = sum(r.generation_tokens for r in records)
+    return {
+        "finished_requests": len(records),
+        "qps": len(records) / total_time,
+        "input_tokens_per_s": sum(r.prompt_tokens for r in records) / total_time,
+        "output_tokens_per_s": gen_tokens / total_time,
+        "gen_speed_per_request": (
+            sum(r.generation_tokens / r.generation_time for r in records)
+            / len(records)
+        ),
+        "avg_ttft_s": sum(ttfts) / len(ttfts),
+        "p50_ttft_s": ttfts[len(ttfts) // 2],
+        "total_output_tokens": gen_tokens,
+        "total_prompt_tokens": sum(r.prompt_tokens for r in records),
+        "elapsed_s": total_time,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--base-url", default="http://localhost:8000")
+    ap.add_argument("--model", default="llama-1b")
+    ap.add_argument("--num-users", type=int, default=16)
+    ap.add_argument("--num-rounds", type=int, default=4)
+    ap.add_argument("--system-prompt-words", type=int, default=120)
+    ap.add_argument("--question-words", type=int, default=12)
+    ap.add_argument("--answer-tokens", type=int, default=64)
+    ap.add_argument("--gap-between-users", type=float, default=0.0)
+    ap.add_argument("--session-header", default="x-user-id")
+    ap.add_argument("--api-key", default=None)
+    ap.add_argument("--warmup-rounds", type=int, default=0,
+                    help="Full extra passes run (and discarded) before the "
+                         "timed workload, so device compile happens outside "
+                         "the measurement")
+    args = ap.parse_args()
+    cfg = WorkloadConfig(
+        base_url=args.base_url, model=args.model, num_users=args.num_users,
+        num_rounds=args.num_rounds,
+        system_prompt_words=args.system_prompt_words,
+        question_words=args.question_words, answer_tokens=args.answer_tokens,
+        gap_between_users_s=args.gap_between_users,
+        session_header=args.session_header, api_key=args.api_key,
+    )
+    if args.warmup_rounds > 0:
+        warm_cfg = WorkloadConfig(**{**cfg.__dict__,
+                                     "num_rounds": args.warmup_rounds})
+        asyncio.run(run_workload(warm_cfg))
+    records = asyncio.run(run_workload(cfg))
+    print(json.dumps(summarize(records), indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

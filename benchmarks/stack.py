@@ -1,0 +1,117 @@
+"""Bring up the serving STACK (engine API server + router) as subprocesses.
+
+Used by bench.py and the e2e tests so the recorded benchmark exercises the
+same deployment shape the reference measures: client -> router (session
+routing, SSE relay) -> engine pod (reference tutorials/
+07-benchmark-multi-round-qa-single-gpu.md procedure).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def wait_health(url: str, timeout_s: float, proc: subprocess.Popen,
+                name: str) -> None:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"{name} exited with code {proc.returncode} before becoming "
+                f"healthy (see its log output)"
+            )
+        try:
+            with urllib.request.urlopen(url, timeout=2) as resp:
+                if resp.status == 200:
+                    return
+        except Exception:  # noqa: BLE001 — not up yet
+            time.sleep(1.0)
+    raise TimeoutError(f"{name} not healthy after {timeout_s}s ({url})")
+
+
+@dataclass
+class StackHandle:
+    engine: subprocess.Popen
+    router: subprocess.Popen
+    engine_url: str
+    router_url: str
+    log_paths: List[str] = field(default_factory=list)
+
+    def terminate(self) -> None:
+        for proc in (self.router, self.engine):
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in (self.router, self.engine):
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=15)
+
+
+def launch_stack(
+    model: str,
+    *,
+    engine_args: Optional[List[str]] = None,
+    router_args: Optional[List[str]] = None,
+    routing_logic: str = "session",
+    served_model: Optional[str] = None,
+    startup_timeout_s: float = 900.0,
+    log_dir: str = "/tmp",
+) -> StackHandle:
+    """Start engine + router; block until both are healthy."""
+    engine_port = free_port()
+    router_port = free_port()
+    engine_url = f"http://127.0.0.1:{engine_port}"
+    router_url = f"http://127.0.0.1:{router_port}"
+    served = served_model or model
+
+    elog = os.path.join(log_dir, f"pstpu-bench-engine-{engine_port}.log")
+    rlog = os.path.join(log_dir, f"pstpu-bench-router-{router_port}.log")
+
+    engine_cmd = [
+        sys.executable, "-m", "production_stack_tpu.server.api_server",
+        "--model", model, "--port", str(engine_port),
+        *(engine_args or []),
+    ]
+    engine = subprocess.Popen(
+        engine_cmd, stdout=open(elog, "w"), stderr=subprocess.STDOUT,
+    )
+    try:
+        wait_health(f"{engine_url}/health", startup_timeout_s, engine,
+                    "engine")
+        router_cmd = [
+            sys.executable, "-m", "production_stack_tpu.router.app",
+            "--port", str(router_port),
+            "--service-discovery", "static",
+            "--static-backends", engine_url,
+            "--static-models", served,
+            "--routing-logic", routing_logic,
+            *(router_args or []),
+        ]
+        router = subprocess.Popen(
+            router_cmd, stdout=open(rlog, "w"), stderr=subprocess.STDOUT,
+        )
+        try:
+            wait_health(f"{router_url}/health", 120.0, router, "router")
+        except Exception:
+            router.kill()
+            raise
+    except Exception:
+        engine.kill()
+        raise
+    return StackHandle(
+        engine=engine, router=router, engine_url=engine_url,
+        router_url=router_url, log_paths=[elog, rlog],
+    )

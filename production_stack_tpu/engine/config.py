@@ -43,8 +43,10 @@ class EngineConfig:
     data_parallel_size: int = 1
     # --- kernels ---
     # "auto"   -> "paged" (Pallas flash-decode against the HBM pool, no window
-    #             copy) when the backend is a TPU and the model supports it
-    #             (llama family, head_dim % 128 == 0), else "window".
+    #             copy) when the backend is a TPU, the model supports it
+    #             (llama family; head_dim divides or is a multiple of 128 via
+    #             lane packing), and the worst-case gathered window would be
+    #             large; else "window".
     # "window" -> decode gathers the live KV into a contiguous per-dispatch
     #             window ("xla" accepted as a legacy alias).
     # "paged"  -> force the Pallas path ("pallas" accepted as an alias);
@@ -96,9 +98,11 @@ class EngineConfig:
         if v in ("pallas", "paged"):
             if not supported:
                 raise ValueError(
-                    f"attn_impl={v!r} requires a llama-family model with "
-                    f"head_dim % 128 == 0 and SUPER_TOKENS-aligned block "
-                    f"size; got arch={model_config.arch} "
+                    f"attn_impl={v!r} requires a llama-family model whose "
+                    f"head_dim divides or is a multiple of 128 (lane "
+                    f"packing), with block_size dividing the superpage and "
+                    f"divisible by the pack factor; got "
+                    f"arch={model_config.arch} "
                     f"head_dim={model_config.head_dim_} "
                     f"block_size={self.block_size}"
                 )
@@ -107,9 +111,24 @@ class EngineConfig:
             raise ValueError(f"Unknown attn_impl {v!r}")
         import jax
 
-        return "paged" if (
-            supported and jax.default_backend() not in ("cpu",)
-        ) else "window"
+        if not supported or jax.default_backend() in ("cpu",):
+            return "window"
+        # Hybrid policy (r3 measurements, v5e): the window path amortizes one
+        # gathered KV copy over the fused scan and wins while that copy is
+        # modest (llama-1b @ live 1k: 235 vs 322 ms/dispatch); the paged
+        # kernel reads the pool in place — no copy, no pool halving — and
+        # wins once the live KV is large (llama-3b @ 8k: 451 vs 245 tok/s,
+        # and window cannot represent 32k x batch at all). Cross over when
+        # the worst-case window (every sequence at max_model_len) exceeds
+        # ~4 GiB (between those two measured points).
+        import jax.numpy as jnp
+
+        worst_window_bytes = (
+            2 * model_config.num_layers * model_config.num_kv_heads
+            * model_config.head_dim_ * jnp.dtype(self.dtype).itemsize
+            * self.max_model_len * self.max_num_seqs
+        )
+        return "paged" if worst_window_bytes > (4 << 30) else "window"
 
     @property
     def model_name(self) -> str:

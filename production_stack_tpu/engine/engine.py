@@ -108,6 +108,12 @@ class ServingEngine:
         self._new_work = asyncio.Event()
         self._loop_task: Optional[asyncio.Task] = None
         self._running = False
+        # Optional per-dispatch timeline (production debugging): set
+        # PSTPU_DISPATCH_LOG=/path to append one line per device dispatch.
+        import os
+
+        _dlog = os.environ.get("PSTPU_DISPATCH_LOG")
+        self._dispatch_log = open(_dlog, "a") if _dlog else None
         # telemetry
         self.start_time = time.monotonic()
         self.prompt_tokens_total = 0
@@ -139,6 +145,9 @@ class ServingEngine:
             self._loop_task = None
         if self.offload is not None:
             self.offload.close()
+        if self._dispatch_log is not None:
+            self._dispatch_log.close()
+            self._dispatch_log = None
 
     @property
     def is_healthy(self) -> bool:
@@ -228,9 +237,17 @@ class ServingEngine:
             step = self._step_counter
             self._step_counter += 1
             try:
+                t0 = time.monotonic()
                 next_tokens = await loop.run_in_executor(
                     None, self.runner.execute, batch, step
                 )
+                if self._dispatch_log is not None:
+                    self._dispatch_log.write(
+                        f"{batch.kind} rows={len(batch.seqs)} "
+                        f"kt={batch.num_steps if batch.kind == 'decode' else max(batch.chunk_lens)} "
+                        f"ms={(time.monotonic() - t0) * 1000:.1f}\n"
+                    )
+                    self._dispatch_log.flush()
             except Exception:  # noqa: BLE001 — engine loop must survive
                 logger.exception("Model step failed; aborting batch")
                 for seq in batch.seqs:

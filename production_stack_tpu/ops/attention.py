@@ -237,13 +237,25 @@ def gather_window(
     """One gather per dispatch: paged pool -> contiguous per-sequence windows
     [L, Hkv, B, Mb*bs, Dh]. Amortized over every layer and every fused decode
     step of the dispatch (a per-layer gather is ~5 ms/step on a v5e at
-    B=16/S=1024 — the profiled round-1 bottleneck)."""
+    B=16/S=1024 — the profiled round-1 bottleneck).
+
+    Indexes BLOCKS of a [.., num_blocks, bs, Dh] view rather than slots of
+    the flat pool: each gathered element is then a contiguous bs*Dh run
+    (16x fewer indices, 16x longer runs), which XLA lowers to block-sized
+    copies instead of row-sized ones — the slot-indexed form measured only
+    ~2 GB/s on a v5e (r3 profiling), making the gather the prefill
+    bottleneck."""
     b, mb = block_tables.shape
-    slots = (
-        block_tables[:, :, None] * block_size
-        + jnp.arange(block_size, dtype=block_tables.dtype)[None, None, :]
-    ).reshape(b, mb * block_size)
-    return kv_k[:, :, slots], kv_v[:, :, slots]
+    l, hkv, num_slots, dh = kv_k.shape
+    nb = num_slots // block_size
+    kr = kv_k.reshape(l, hkv, nb, block_size, dh)
+    vr = kv_v.reshape(l, hkv, nb, block_size, dh)
+    win_k = kr[:, :, block_tables]  # [L, Hkv, B, Mb, bs, Dh]
+    win_v = vr[:, :, block_tables]
+    return (
+        win_k.reshape(l, hkv, b, mb * block_size, dh),
+        win_v.reshape(l, hkv, b, mb * block_size, dh),
+    )
 
 
 def gather_kv_pages(pool: jax.Array, block_tables: jax.Array, block_size: int) -> jax.Array:
@@ -251,12 +263,13 @@ def gather_kv_pages(pool: jax.Array, block_tables: jax.Array, block_size: int) -
 
     pool: [Hkv, num_slots, Dh] (head-major so the Pallas kernel DMAs pages
     with no relayout); block_tables: [B, Mb] -> [Hkv, B, Mb*bs, Dh].
+    Block-indexed for contiguous bs*Dh copy runs (see gather_window).
     """
     b, mb = block_tables.shape
-    slots = block_tables[:, :, None] * block_size + jnp.arange(
-        block_size, dtype=block_tables.dtype
-    )[None, None, :]
-    return pool[:, slots.reshape(b, mb * block_size)]
+    hkv, num_slots, dh = pool.shape
+    nb = num_slots // block_size
+    pr = pool.reshape(hkv, nb, block_size, dh)
+    return pr[:, block_tables].reshape(hkv, b, mb * block_size, dh)
 
 
 @functools.partial(jax.jit, static_argnames=("block_size",))

@@ -375,11 +375,13 @@ def build_engine_from_args(args: argparse.Namespace) -> ServingEngine:
         hbm_utilization=args.gpu_memory_utilization,
         enable_prefix_caching=not args.no_enable_prefix_caching,
         max_num_seqs=args.max_num_seqs,
-        max_num_batched_tokens=args.max_num_batched_tokens,
+        **({"max_num_batched_tokens": args.max_num_batched_tokens}
+           if args.max_num_batched_tokens is not None else {}),
         tensor_parallel_size=args.tensor_parallel_size,
         sequence_parallel_size=args.sequence_parallel_size,
         data_parallel_size=args.data_parallel_size,
-        num_decode_steps=args.num_decode_steps,
+        **({"num_decode_steps": args.num_decode_steps}
+           if args.num_decode_steps is not None else {}),
         attn_impl=args.attn_impl,
         enable_warmup=not args.no_warmup,
     )
@@ -400,11 +402,13 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--gpu-memory-utilization", type=float, default=0.9)
     p.add_argument("--no-enable-prefix-caching", action="store_true")
     p.add_argument("--max-num-seqs", type=int, default=64)
-    p.add_argument("--max-num-batched-tokens", type=int, default=1024)
+    # None -> inherit the EngineConfig dataclass default (the tuned value);
+    # an explicit flag always wins (the Helm chart renders these).
+    p.add_argument("--max-num-batched-tokens", type=int, default=None)
     p.add_argument("--tensor-parallel-size", type=int, default=1)
     p.add_argument("--sequence-parallel-size", type=int, default=1)
     p.add_argument("--data-parallel-size", type=int, default=1)
-    p.add_argument("--num-decode-steps", type=int, default=8)
+    p.add_argument("--num-decode-steps", type=int, default=None)
     p.add_argument("--attn-impl", default="auto",
                    choices=["auto", "window", "paged", "xla", "pallas"])
     p.add_argument("--no-warmup", action="store_true",

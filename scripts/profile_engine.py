@@ -37,7 +37,7 @@ async def main():
 
     await engine.start()
     try:
-        res = await bench._bench(engine, 16, 2, 600, 64)
+        res = await bench._bench_engine(engine, 16, 2, 600, 64)
     finally:
         await engine.stop()
     print(res)

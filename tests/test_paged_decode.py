@@ -34,7 +34,7 @@ def _pool_fixture(L=2, hkv=2, g=2, b=3, s=96, bs=16, dh=128, seed=0):
     rng = np.random.default_rng(seed)
     h = hkv * g
     mb = s // bs
-    nslots = 1 + b * mb * bs
+    nslots = (1 + b * mb) * bs  # block 0 is the reserved null block
     kp = jnp.asarray(rng.normal(size=(L, hkv, nslots, dh)), jnp.float32)
     vp = jnp.asarray(rng.normal(size=(L, hkv, nslots, dh)), jnp.float32)
     q = jnp.asarray(rng.normal(size=(b, h, dh)), jnp.float32)
@@ -162,11 +162,12 @@ def test_resolved_attn_impl():
     # auto on CPU -> window even when the kernel would be supported.
     assert cfg.resolved_attn_impl(dh128) == "window"
     assert EngineConfig(attn_impl="paged").resolved_attn_impl(dh128) == "paged"
+    # lane-packed small head dims are kernel-supported too (llama-1b class)
+    assert EngineConfig(attn_impl="paged").resolved_attn_impl(dh64) == "paged"
     assert EngineConfig(attn_impl="pallas").resolved_attn_impl(dh128) == "paged"
     assert EngineConfig(attn_impl="xla").resolved_attn_impl(dh128) == "window"
-    for bad in (dh64, opt):
-        with pytest.raises(ValueError):
-            EngineConfig(attn_impl="paged").resolved_attn_impl(bad)
+    with pytest.raises(ValueError):  # non-llama arch never takes the kernel
+        EngineConfig(attn_impl="paged").resolved_attn_impl(opt)
     with pytest.raises(ValueError):
         EngineConfig(attn_impl="nope").resolved_attn_impl(dh128)
 

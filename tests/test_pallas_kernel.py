@@ -15,8 +15,11 @@ from production_stack_tpu.ops.pallas.paged_attention import (
 def test_supports_gate():
     assert supports_pallas_decode(128, 16)
     assert supports_pallas_decode(256, 32)
-    assert not supports_pallas_decode(64, 16)    # dh not 128-aligned
+    assert supports_pallas_decode(64, 16)        # lane-packed (2 tok/row)
+    assert supports_pallas_decode(32, 16)        # lane-packed (4 tok/row)
+    assert not supports_pallas_decode(96, 16)    # 128 not divisible by dh
     assert not supports_pallas_decode(128, 48)   # bs doesn't divide superpage
+    assert not supports_pallas_decode(32, 2)     # bs < pack factor
 
 
 def test_decode_kernel_matches_xla_interpret():
