@@ -53,6 +53,11 @@ class WorkloadConfig:
     session_header: str = "x-user-id"
     api_key: Optional[str] = None
     timeout_s: float = 300.0
+    # Distinguishes question text across workload invocations: a warmup pass
+    # must use a different tag than the timed pass so only the
+    # (intentionally) shared system prefix is warm in the engine's prefix
+    # cache, not the full prompts.
+    tag: str = "round"
 
 
 @dataclass
@@ -83,7 +88,7 @@ class UserSession:
     async def _one_round(self, http: aiohttp.ClientSession, rnd: int) -> None:
         cfg = self.cfg
         question = (
-            f"user {self.user_id} round {rnd}: "
+            f"user {self.user_id} {cfg.tag} {rnd}: "
             + synth_text(cfg.question_words, seed=self.user_id * 31 + rnd)
         )
         self.messages.append({"role": "user", "content": question})

@@ -47,6 +47,7 @@ class StackHandle:
     engine_url: str
     router_url: str
     log_paths: List[str] = field(default_factory=list)
+    log_files: List[object] = field(default_factory=list)
 
     def terminate(self) -> None:
         for proc in (self.router, self.engine):
@@ -58,6 +59,9 @@ class StackHandle:
             except subprocess.TimeoutExpired:
                 proc.kill()
                 proc.wait(timeout=15)
+        for f in self.log_files:
+            f.close()
+        self.log_files.clear()
 
 
 def launch_stack(
@@ -85,9 +89,11 @@ def launch_stack(
         "--model", model, "--port", str(engine_port),
         *(engine_args or []),
     ]
+    elog_f = open(elog, "w")
     engine = subprocess.Popen(
-        engine_cmd, stdout=open(elog, "w"), stderr=subprocess.STDOUT,
+        engine_cmd, stdout=elog_f, stderr=subprocess.STDOUT,
     )
+    rlog_f = None
     try:
         wait_health(f"{engine_url}/health", startup_timeout_s, engine,
                     "engine")
@@ -100,8 +106,9 @@ def launch_stack(
             "--routing-logic", routing_logic,
             *(router_args or []),
         ]
+        rlog_f = open(rlog, "w")
         router = subprocess.Popen(
-            router_cmd, stdout=open(rlog, "w"), stderr=subprocess.STDOUT,
+            router_cmd, stdout=rlog_f, stderr=subprocess.STDOUT,
         )
         try:
             wait_health(f"{router_url}/health", 120.0, router, "router")
@@ -110,8 +117,12 @@ def launch_stack(
             raise
     except Exception:
         engine.kill()
+        elog_f.close()
+        if rlog_f is not None:
+            rlog_f.close()
         raise
     return StackHandle(
         engine=engine, router=router, engine_url=engine_url,
         router_url=router_url, log_paths=[elog, rlog],
+        log_files=[elog_f, rlog_f],
     )

@@ -1,0 +1,15 @@
+{{- define "chart.fullname" -}}
+{{ .Release.Name }}
+{{- end }}
+
+{{- define "chart.engineLabels" -}}
+{{ toYaml .Values.servingEngineSpec.labels }}
+{{- end }}
+
+{{- define "chart.routerLabels" -}}
+{{ toYaml .Values.routerSpec.labels }}
+{{- end }}
+
+{{- define "labels.toCommaSeparatedList" -}}
+environment={{ .Values.servingEngineSpec.labels.environment }},release={{ .Values.servingEngineSpec.labels.release }}
+{{- end }}

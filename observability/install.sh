@@ -1,0 +1,28 @@
+#!/bin/bash
+# Install the observability plane: kube-prometheus-stack (Prometheus +
+# Grafana), the custom-metrics adapter, and the serving dashboard.
+# Mirrors the reference procedure (observability/install.sh) for the TPU
+# stack.
+set -euo pipefail
+NS="${MONITORING_NAMESPACE:-monitoring}"
+
+helm repo add prometheus-community https://prometheus-community.github.io/helm-charts
+helm repo update
+
+helm upgrade --install kube-prom-stack prometheus-community/kube-prometheus-stack \
+  --namespace "$NS" --create-namespace \
+  -f "$(dirname "$0")/kube-prom-stack.yaml"
+
+helm upgrade --install prometheus-adapter prometheus-community/prometheus-adapter \
+  --namespace "$NS" \
+  -f "$(dirname "$0")/prom-adapter.yaml"
+
+# Import the dashboard into Grafana via a ConfigMap the sidecar picks up.
+kubectl create configmap pstpu-serving-dashboard \
+  --namespace "$NS" \
+  --from-file=pstpu-serving.json="$(dirname "$0")/grafana-dashboard.json" \
+  --dry-run=client -o yaml | kubectl label -f - --local --dry-run=client \
+  -o yaml grafana_dashboard=1 | kubectl apply -f -
+
+echo "Observability stack installed in namespace $NS."
+echo "Port-forward Grafana:  kubectl -n $NS port-forward svc/kube-prom-stack-grafana 3000:80"

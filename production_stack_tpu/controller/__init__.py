@@ -1,0 +1,7 @@
+from production_stack_tpu.controller.staticroute import (
+    HealthCheckConfig,
+    StaticRoute,
+    StaticRouteReconciler,
+)
+
+__all__ = ["StaticRoute", "HealthCheckConfig", "StaticRouteReconciler"]
