@@ -9,7 +9,7 @@ where they exist so the chart stays recognizable.
 
 import os
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Dict, Optional
 
 
 @dataclass
@@ -66,6 +66,8 @@ class EngineConfig:
     kv_remote_serde: str = field(
         default_factory=lambda: os.environ.get("LMCACHE_REMOTE_SERDE", "naive")
     )
+    # --- LoRA (vLLM --lora-modules convention: name -> PEFT checkpoint dir)
+    lora_modules: Dict[str, str] = field(default_factory=dict)
     # --- weights ---
     load_format: str = "auto"               # "auto" | "safetensors" | "dummy"
     seed: int = 0

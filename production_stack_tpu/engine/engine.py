@@ -75,9 +75,24 @@ class ServingEngine:
             sp=config.sequence_parallel_size,
             tp=config.tensor_parallel_size,
         )
+        self.lora_registry = None
+        if config.lora_modules:
+            from production_stack_tpu.models.lora import (
+                LoRARegistry,
+                load_peft_adapter,
+            )
+
+            if self.model_config.arch != "llama":
+                raise ValueError("LoRA serving is llama-family only")
+            self.lora_registry = LoRARegistry(self.model_config)
+            for name, path in config.lora_modules.items():
+                self.lora_registry.add(
+                    load_peft_adapter(name, path, self.model_config)
+                )
         self.runner = ModelRunner(
             config, self.model_config, self.mesh,
             params=params, num_kv_blocks=num_kv_blocks,
+            lora_registry=self.lora_registry,
         )
         self.block_manager = BlockPoolManager(
             self.runner.num_kv_blocks, config.block_size,
@@ -162,8 +177,10 @@ class ServingEngine:
         prompt_token_ids: Optional[List[int]] = None,
         sampling: Optional[SamplingParams] = None,
         request_id: Optional[str] = None,
+        lora_adapter: Optional[str] = None,
     ) -> AsyncIterator[RequestOutput]:
-        """Submit a request; yields streaming RequestOutput deltas."""
+        """Submit a request; yields streaming RequestOutput deltas.
+        ``lora_adapter`` selects a registered adapter by name (None = base)."""
         request_id = request_id or random_uuid("req-")
         sampling = sampling or SamplingParams()
         if prompt_token_ids is None:
@@ -171,11 +188,18 @@ class ServingEngine:
             prompt_token_ids = self.tokenizer.encode(prompt)
         if not prompt_token_ids:
             prompt_token_ids = [self.tokenizer.eos_token_id or 0]
+        adapter_idx = 0
+        if lora_adapter is not None:
+            if self.lora_registry is None:
+                raise ValueError("no LoRA adapters are registered")
+            adapter_idx = self.lora_registry.adapter_index(lora_adapter)
         seq = Sequence(
             request_id=request_id,
             prompt_token_ids=list(prompt_token_ids),
             sampling=sampling,
             eos_token_id=self.tokenizer.eos_token_id,
+            adapter_idx=adapter_idx,
+            adapter_name=lora_adapter if adapter_idx else None,
         )
         state = _StreamState(
             queue=asyncio.Queue(), detok=IncrementalDetokenizer(self.tokenizer)

@@ -122,13 +122,16 @@ class BlockPoolManager:
             out.append(blk)
         return out
 
-    def lookup_prefix(self, token_ids: Sequence[int]) -> Tuple[List[int], int]:
+    def lookup_prefix(self, token_ids: Sequence[int],
+                      seed: bytes = b"") -> Tuple[List[int], int]:
         """Find the longest cached full-block prefix of ``token_ids``.
 
         Returns (cached_block_ids, num_cached_tokens). Does NOT take refs and
         does NOT touch the hit/query counters; pair with ``allocate_prompt``.
         At least one prompt token is always left uncached so prefill has a
-        position to compute logits from.
+        position to compute logits from. ``seed`` namespaces the hash chain:
+        KV computed under different LoRA adapters must never be shared, so
+        each adapter seeds its own chain (Sequence.hash_seed).
         """
         if not self.enable_prefix_caching:
             return [], 0
@@ -136,7 +139,7 @@ class BlockPoolManager:
         max_cached_tokens = len(token_ids) - 1
         usable_full_blocks = max_cached_tokens // self.block_size
         blocks: List[int] = []
-        prev = b""
+        prev = seed
         for i in range(usable_full_blocks):
             chunk = token_ids[i * self.block_size:(i + 1) * self.block_size]
             h = _block_hash(prev, chunk)
@@ -148,7 +151,7 @@ class BlockPoolManager:
         return blocks, len(blocks) * self.block_size
 
     def allocate_prompt(
-        self, token_ids: Sequence[int]
+        self, token_ids: Sequence[int], seed: bytes = b""
     ) -> Optional[Tuple[List[int], int]]:
         """Allocate the block table for a new prompt, reusing cached prefixes.
 
@@ -156,7 +159,7 @@ class BlockPoolManager:
         """
         if self.num_free_blocks == 0:
             return None  # cheap out: don't hash the prompt on a starved pool
-        cached, n_cached = self.lookup_prefix(token_ids)
+        cached, n_cached = self.lookup_prefix(token_ids, seed)
         total_blocks = -(-len(token_ids) // self.block_size)
         n_new = total_blocks - len(cached)
         # Pin the cached blocks FIRST: reviving an evictable block shrinks the

@@ -43,6 +43,9 @@ logger = init_logger(__name__)
 
 _SEED_MULT = np.uint32(1000003)
 _POS_SENTINEL = np.int32(2**30)  # ring_pos value for not-yet-written entries
+# int32 per-row scalar rows at the head of each packed host buffer; row 8 is
+# the LoRA adapter index (0 = base model).
+NUM_SCALARS = 9
 
 
 def _dtype(name: str):
@@ -96,8 +99,13 @@ class ModelRunner:
         mesh: Mesh,
         params: Optional[Dict] = None,
         num_kv_blocks: Optional[int] = None,
+        lora_registry=None,
     ):
         self.config = config
+        # {target: (A [L,Na+1,in,r], B [L,Na+1,r,out])} device stacks; rows
+        # select adapters by index (models/lora.py:LoRARegistry). None/empty
+        # keeps the traced graphs LoRA-free.
+        self.lora_stacks = lora_registry.stacks() if lora_registry else None
         self.model_config = model_config
         self.mesh = mesh
         # "paged": decode attends directly against the HBM pool inside the
@@ -252,7 +260,7 @@ class ModelRunner:
         cfg = self.config
         bs = cfg.block_size
         mc = self.model_config
-        scalars = packed[: 8 * b].reshape(8, b)
+        scalars = packed[: NUM_SCALARS * b].reshape(NUM_SCALARS, b)
         tokens0 = scalars[0]
         pos0 = scalars[1]
         budget = scalars[2]
@@ -261,7 +269,9 @@ class ModelRunner:
         temps = jax.lax.bitcast_convert_type(scalars[5], jnp.float32)
         top_k = scalars[6]
         top_p = jax.lax.bitcast_convert_type(scalars[7], jnp.float32)
-        block_tables = packed[8 * b:].reshape(b, mb)
+        adapter_idx = scalars[8]
+        lora = (adapter_idx, self.lora_stacks) if self.lora_stacks else None
+        block_tables = packed[NUM_SCALARS * b:].reshape(b, mb)
 
         # Per-step write slots [K, b] (0 = reserved null block for rows whose
         # budget ran out) and per-step seeds [K, b].
@@ -302,7 +312,7 @@ class ModelRunner:
             hidden, k_new, v_new = self._forward(
                 params, mc, toks[:, None], positions, ones,
                 win_k, win_v, win_len, ring_k, ring_v, ring_pos,
-                paged=paged,
+                paged=paged, lora=lora,
             )
             logits = self._logits_fn(params, mc, hidden[:, 0])
             nxt = sample_tokens(logits, temps, top_k, top_p, seeds_j)
@@ -343,9 +353,9 @@ class ModelRunner:
         mb = _bucket(max(len(s.block_ids) for s in seqs), 1,
                      max(1, cfg.max_blocks_per_seq))
 
-        packed = np.zeros((8 * b + b * mb,), np.int32)
-        sc = packed[: 8 * b].reshape(8, b)
-        bt = packed[8 * b:].reshape(b, mb)
+        packed = np.zeros((NUM_SCALARS * b + b * mb,), np.int32)
+        sc = packed[: NUM_SCALARS * b].reshape(NUM_SCALARS, b)
+        bt = packed[NUM_SCALARS * b:].reshape(b, mb)
         f32 = sc.view(np.float32)
         u32 = sc.view(np.uint32)
         for i, s in enumerate(seqs):
@@ -355,6 +365,7 @@ class ModelRunner:
             sc[2, i] = batch.decode_steps[i]
             u32[3, i] = _seed_base(s)
             u32[4, i] = len(s.output_token_ids)
+            sc[8, i] = s.adapter_idx
             sp = s.sampling
             f32[5, i] = sp.temperature
             sc[6, i] = sp.top_k
@@ -384,7 +395,7 @@ class ModelRunner:
         cfg = self.config
         bs = cfg.block_size
         mc = self.model_config
-        scalars = packed[: 8 * b].reshape(8, b)
+        scalars = packed[: NUM_SCALARS * b].reshape(NUM_SCALARS, b)
         chunk_start = scalars[0]
         chunk_lens = scalars[1]
         seed_base = jax.lax.bitcast_convert_type(scalars[2], jnp.uint32)
@@ -392,8 +403,10 @@ class ModelRunner:
         temps = jax.lax.bitcast_convert_type(scalars[4], jnp.float32)
         top_k = scalars[5]
         top_p = jax.lax.bitcast_convert_type(scalars[6], jnp.float32)
-        block_tables = packed[8 * b: 8 * b + b * mb].reshape(b, mb)
-        token_ids = packed[8 * b + b * mb:].reshape(b, t)
+        adapter_idx = scalars[8]
+        lora = (adapter_idx, self.lora_stacks) if self.lora_stacks else None
+        block_tables = packed[NUM_SCALARS * b: NUM_SCALARS * b + b * mb].reshape(b, mb)
+        token_ids = packed[NUM_SCALARS * b + b * mb:].reshape(b, t)
 
         t_iota = jnp.arange(t, dtype=jnp.int32)
         positions = jnp.minimum(
@@ -413,7 +426,7 @@ class ModelRunner:
         hidden, k_new, v_new = self._forward(
             params, mc, token_ids, positions, chunk_lens,
             win_k, win_v, win_len,
-            act_sharding=self._act_sharding,
+            act_sharding=self._act_sharding, lora=lora,
         )
         logit_idx = jnp.maximum(chunk_lens - 1, 0)
         last_hidden = hidden[jnp.arange(b), logit_idx]            # [b, D]
@@ -438,10 +451,10 @@ class ModelRunner:
                      max(1, cfg.max_blocks_per_seq))
         has_window = any(st > 0 for st in batch.chunk_starts)
 
-        packed = np.zeros((8 * b + b * mb + b * t,), np.int32)
-        sc = packed[: 8 * b].reshape(8, b)
-        bt = packed[8 * b: 8 * b + b * mb].reshape(b, mb)
-        toks = packed[8 * b + b * mb:].reshape(b, t)
+        packed = np.zeros((NUM_SCALARS * b + b * mb + b * t,), np.int32)
+        sc = packed[: NUM_SCALARS * b].reshape(NUM_SCALARS, b)
+        bt = packed[NUM_SCALARS * b: NUM_SCALARS * b + b * mb].reshape(b, mb)
+        toks = packed[NUM_SCALARS * b + b * mb:].reshape(b, t)
         f32 = sc.view(np.float32)
         u32 = sc.view(np.uint32)
         for i, s in enumerate(seqs):
@@ -450,6 +463,7 @@ class ModelRunner:
             sc[1, i] = ln
             u32[2, i] = _seed_base(s)
             u32[3, i] = len(s.output_token_ids)
+            sc[8, i] = s.adapter_idx
             sp = s.sampling
             f32[4, i] = sp.temperature
             sc[5, i] = sp.top_k
@@ -636,7 +650,7 @@ class ModelRunner:
                 self.params,
             )
             self._decode.lower(
-                params_spec, spec(8 * b + b * mb), kv_spec, kv_spec,
+                params_spec, spec(NUM_SCALARS * b + b * mb), kv_spec, kv_spec,
                 b=b, mb=mb, num_steps=k,
             ).compile()
             t = _bucket(cfg.max_num_batched_tokens, 16,
@@ -644,7 +658,7 @@ class ModelRunner:
             for has_window, pb in ((False, 1), (True, b)):
                 pb = _bucket(pb, 1, max(1, cfg.max_num_seqs))
                 self._prefill.lower(
-                    params_spec, spec(8 * pb + pb * mb + pb * t), kv_spec,
+                    params_spec, spec(NUM_SCALARS * pb + pb * mb + pb * t), kv_spec,
                     kv_spec, b=pb, t=t, mb=mb, has_window=has_window,
                 ).compile()
             logger.info("Warmup compiled: decode(b=%d,mb=%d,K=%d) + prefill "

@@ -60,6 +60,20 @@ class Sequence:
     num_computed_tokens: int = 0       # tokens whose KV is in the device pool
     num_cached_tokens: int = 0         # prefix-cache hits (telemetry)
     num_preemptions: int = 0
+    # LoRA adapter index in the engine's registry (0 = base model) rides the
+    # packed buffer so one batch can mix adapters; adapter_name keys the
+    # prefix-cache namespace (models/lora.py).
+    adapter_idx: int = 0
+    adapter_name: Optional[str] = None
+
+    @property
+    def hash_seed(self) -> bytes:
+        """Prefix-cache hash-chain seed: KV under different LoRA adapters is
+        different data and must never be cache-shared — on device OR in the
+        host/remote offload tiers. Keyed by adapter NAME, not registry
+        index: indices are per-engine-process orderings and would alias
+        different adapters across engines sharing a remote KV tier."""
+        return b"" if not self.adapter_name else f"lora:{self.adapter_name}".encode()
     first_token_time: Optional[float] = None
     # prefix-cache hash chain bookkeeping
     _prev_hash: bytes = b""
@@ -202,18 +216,21 @@ class Scheduler:
             if len(cands) >= max_rows:
                 break
             if not cand.block_ids:
-                alloc = self.block_manager.allocate_prompt(cand.all_token_ids)
+                alloc = self.block_manager.allocate_prompt(
+                    cand.all_token_ids, seed=cand.hash_seed
+                )
                 if alloc is None:
                     continue  # starved; a later cand may already hold blocks
                 cand.block_ids, cand.num_cached_tokens = alloc
                 cand.num_computed_tokens = cand.num_cached_tokens
+                cand._prev_hash = cand.hash_seed
                 newly_allocated.add(cand.request_id)
                 if self.offload is not None:
                     # Host/remote KV tiers may extend the cached prefix past
                     # what survived in device HBM (LMCache-equivalent path).
                     restored = self.offload.try_restore(
                         cand.all_token_ids, cand.block_ids,
-                        cand.num_computed_tokens,
+                        cand.num_computed_tokens, seed=cand.hash_seed,
                     )
                     cand.num_computed_tokens += restored
                     cand.num_cached_tokens += restored
@@ -255,7 +272,7 @@ class Scheduler:
                 cand.block_ids = []
                 cand.num_computed_tokens = 0
                 cand.num_cached_tokens = 0
-                cand._prev_hash = b""
+                cand._prev_hash = cand.hash_seed
                 cand._num_hashed_blocks = 0
         starts = [s.num_computed_tokens for s in seqs]
         lens = [
@@ -345,7 +362,7 @@ class Scheduler:
         self.block_manager.free_blocks(seq.block_ids)
         seq.block_ids = []
         seq.num_computed_tokens = 0
-        seq._prev_hash = b""
+        seq._prev_hash = seq.hash_seed
         seq._num_hashed_blocks = 0
         seq.status = SequenceStatus.WAITING
         self.waiting.appendleft(seq)

@@ -14,7 +14,10 @@ with the real CLI parsers). The subset — anything else is a template error:
   * ``$var := <expr>`` assignment
   * ``include "name" <ctx>`` of ``define`` blocks (helpers)
   * pipelines with: ``default``, ``quote``, ``toYaml``, ``toString``,
-    ``indent``, ``nindent``, ``required``, ``printf``, ``join``
+    ``indent``, ``nindent``, ``required``, ``printf``, ``join``, ``kindIs``
+  * paths inside ``range`` bodies MUST be root-anchored (``$.Values...``):
+    real helm rebinds dot to the range element, helm_lite does not — the
+    ``$.`` form is the one both renderers agree on
   * literals: double-quoted strings, ints, floats, true/false
 
 Real ``helm template`` also accepts this chart (the subset is valid Go
@@ -376,6 +379,12 @@ _FUNCS = {
     "ne": lambda r, f, ro, a, b=None: a != b,
     "not": lambda r, f, ro, v=None: not _truthy(v),
     "hasKey": lambda r, f, ro, m, k=None: isinstance(m, dict) and k in m,
+    "kindIs": lambda r, f, ro, kind, v=None: {
+        "map": isinstance(v, dict), "string": isinstance(v, str),
+        "slice": isinstance(v, list), "bool": isinstance(v, bool),
+        "int": isinstance(v, int) and not isinstance(v, bool),
+        "float64": isinstance(v, float), "invalid": v is None,
+    }.get(kind, False),
 }
 
 

@@ -162,20 +162,24 @@ class KVOffloadManager:
         token_ids: Sequence[int],
         block_ids: Sequence[int],
         num_computed_tokens: int,
+        seed: bytes = b"",
     ) -> int:
         """Restore consecutive full blocks after the device-cached prefix.
 
         Returns the number of tokens restored (multiple of block_size).
         Called on the engine loop between device steps, so the scatter into
-        the pools is ordered with model steps.
+        the pools is ordered with model steps. ``seed`` namespaces the hash
+        chain exactly like the device prefix cache (Sequence.hash_seed): KV
+        computed under different LoRA adapters must never be spliced across
+        adapters from the host/remote tiers either.
         """
         if not self.enabled:
             return 0
         bs = self.block_manager.block_size
         if num_computed_tokens % bs != 0:
             return 0  # device cache ended mid-block: nothing contiguous to add
-        # Hash chain up to the restore boundary.
-        prev = b""
+        # Hash chain up to the restore boundary (adapter-namespaced).
+        prev = seed
         for i in range(num_computed_tokens // bs):
             prev = _block_hash(
                 prev, token_ids[i * bs:(i + 1) * bs]

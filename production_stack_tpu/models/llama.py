@@ -101,14 +101,24 @@ def _layer_body(
     ring_k, ring_v, ring_pos,
     paged=None,               # (pool_k, pool_v, block_tables, kv_lens,
     layer_idx=None,           #  block_size, interpret) + scan layer index
+    lora=None,                # (adapter_idx [B], {target: (A, B)} ONE layer)
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     b, t, d = hidden.shape
     h, hkv, dh = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
 
+    def proj(x, target):
+        out = x @ lp[target]
+        if lora is not None and target in lora[1]:
+            from production_stack_tpu.models.lora import lora_delta
+
+            la, lb = lora[1][target]
+            out = out + lora_delta(x, la, lb, lora[0])
+        return out
+
     x = rms_norm(hidden, lp["attn_norm"], cfg.rms_norm_eps)
-    q = x @ lp["wq"]
-    k = x @ lp["wk"]
-    v = x @ lp["wv"]
+    q = proj(x, "wq")
+    k = proj(x, "wk")
+    v = proj(x, "wv")
     if cfg.attention_bias:
         q = q + lp["bq"]
         k = k + lp["bk"]
@@ -154,10 +164,11 @@ def _layer_body(
             q, k, v, positions, chunk_lens,
             win_k, win_v, win_len, ring_k, ring_v, ring_pos,
         )
-    hidden = hidden + attn.reshape(b, t, h * dh) @ lp["wo"]
+    hidden = hidden + proj(attn.reshape(b, t, h * dh), "wo")
 
     x = rms_norm(hidden, lp["mlp_norm"], cfg.rms_norm_eps)
-    mlp = (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+    gated = jax.nn.silu(proj(x, "w_gate")) * proj(x, "w_up")
+    mlp = proj(gated, "w_down")
     # New KV in pool layout [Hkv, B, T, Dh] for the runner's single scatter.
     return hidden + mlp, k.transpose(2, 0, 1, 3), v.transpose(2, 0, 1, 3)
 
@@ -178,6 +189,7 @@ def forward(
     act_sharding=None,
     paged=None,  # (pool_k [L,Hkv,S,Dh], pool_v, block_tables [B,Mb],
                  #  kv_lens [B], block_size, interpret) — paged decode path
+    lora=None,   # (adapter_idx [B], {target: (A [L,Na,in,r], B [L,Na,r,out])})
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Returns (hidden [B,T,D], k_new [L,Hkv,B,T,Dh], v_new [L,Hkv,B,T,Dh]).
 
@@ -204,11 +216,12 @@ def forward(
     have_win = win_k is not None
     have_ring = ring_k is not None
     have_paged = paged is not None
+    have_lora = lora is not None
 
     def scan_fn(h_carry, xs):
         lp = xs[0]
         i = 1
-        wk = wv = rk = rv = li = None
+        wk = wv = rk = rv = li = lo = None
         if have_win:
             wk, wv = xs[i], xs[i + 1]
             i += 2
@@ -217,10 +230,14 @@ def forward(
             i += 2
         if have_paged:
             li = xs[i]
+            i += 1
+        if have_lora:
+            # per-layer slices of the adapter stacks, same adapter_idx rows
+            lo = (lora[0], xs[i])
         h_out, k_l, v_l = _layer_body(
             cfg, h_carry, lp, cos, sin, positions, chunk_lens,
             wk, wv, win_len, rk, rv, ring_pos,
-            paged=paged, layer_idx=li,
+            paged=paged, layer_idx=li, lora=lo,
         )
         return h_out, (k_l, v_l)
 
@@ -231,6 +248,8 @@ def forward(
         xs += (ring_k, ring_v)
     if have_paged:
         xs += (jnp.arange(cfg.num_layers, dtype=jnp.int32),)
+    if have_lora:
+        xs += (lora[1],)  # dict of (A [L,...], B [L,...]) — L axis scanned
     hidden, (k_new, v_new) = jax.lax.scan(scan_fn, hidden, xs)
     hidden = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
     return hidden, k_new, v_new

@@ -91,12 +91,14 @@ def forward(
     *,
     act_sharding=None,
     paged=None,
+    lora=None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Same contract as models/llama.py:forward (see its docstring).
     The paged (Pallas flash-decode) path is llama-family only: OPT head_dim
     (64) is below the kernel's 128-lane alignment, so ``paged`` must be None
     (engine/config.py:resolved_attn_impl never selects it for OPT)."""
     assert paged is None, "paged decode unsupported for OPT (head_dim < 128)"
+    assert lora is None, "LoRA serving is llama-family only"
     hidden = (
         params["embed"][token_ids] + params["pos_embed"][positions + _OPT_POS_OFFSET]
     )

@@ -35,6 +35,19 @@ logger = init_logger(__name__)
 VERSION = "0.1.0"
 
 
+def _parse_lora_modules(items) -> dict:
+    """--lora-modules NAME=PATH entries -> dict, with a usable error."""
+    out = {}
+    for kv in items or []:
+        if "=" not in kv:
+            raise SystemExit(
+                f"--lora-modules entries must be NAME=PATH (got {kv!r})"
+            )
+        name, path = kv.split("=", 1)
+        out[name] = path
+    return out
+
+
 def _error(status: int, message: str, etype: str = "invalid_request_error"):
     return web.json_response(
         ErrorResponse(message=message, type=etype, code=status).to_dict(),
@@ -54,6 +67,15 @@ class APIServer:
         # engines and the router probe authenticates with it
         # (reference src/vllm_router/service_discovery.py:156-169).
         self.api_key = api_key
+
+    def _served_models(self):
+        """Base model plus registered LoRA adapter names: requesting
+        model=<adapter> serves base + that adapter (vLLM --lora-modules
+        convention; engine.lora_registry)."""
+        names = [self.model_name]
+        if self.engine.lora_registry is not None:
+            names += self.engine.lora_registry.names
+        return names
 
     # ----------------------------------------------------------------- routes
     def build_app(self) -> web.Application:
@@ -168,7 +190,9 @@ class APIServer:
 
     async def models(self, request: web.Request) -> web.Response:
         return web.json_response(
-            ModelList(data=[ModelCard(id=self.model_name)]).to_dict()
+            ModelList(data=[
+                ModelCard(id=name) for name in self._served_models()
+            ]).to_dict()
         )
 
     async def health(self, request: web.Request) -> web.Response:
@@ -195,7 +219,7 @@ class APIServer:
         if not messages:
             return _error(400, "'messages' is required")
         model = body.get("model", self.model_name)
-        if model != self.model_name:
+        if model not in self._served_models():
             return _error(404, f"Model '{model}' not found",
                           etype="model_not_found")
         try:
@@ -222,13 +246,17 @@ class APIServer:
                 return _error(400, "'prompt' must not be empty")
             prompt = prompt[0]  # multi-prompt: phase 2
         model = body.get("model", self.model_name)
-        if model != self.model_name:
+        if model not in self._served_models():
             return _error(404, f"Model '{model}' not found",
                           etype="model_not_found")
         sampling = SamplingParams.from_request(body, default_max_tokens=16)
         return await self._generate_response(
             request, body, prompt, sampling, chat=False
         )
+
+    def _lora_name(self, body: dict) -> Optional[str]:
+        model = body.get("model", self.model_name)
+        return model if model != self.model_name else None
 
     async def _generate_response(
         self, request: web.Request, body: dict, prompt: str,
@@ -267,6 +295,7 @@ class APIServer:
             final = None
             try:
                 async for out in self.engine.generate(
+                    lora_adapter=self._lora_name(body),
                     prompt=prompt, sampling=sampling, request_id=request_id
                 ):
                     final = out
@@ -328,7 +357,8 @@ class APIServer:
         text, final = "", None
         try:
             async for out in self.engine.generate(
-                prompt=prompt, sampling=sampling, request_id=request_id
+                prompt=prompt, sampling=sampling, request_id=request_id,
+                lora_adapter=self._lora_name(body),
             ):
                 text += out.text_delta
                 final = out
@@ -384,6 +414,7 @@ def build_engine_from_args(args: argparse.Namespace) -> ServingEngine:
            if args.num_decode_steps is not None else {}),
         attn_impl=args.attn_impl,
         enable_warmup=not args.no_warmup,
+        lora_modules=_parse_lora_modules(args.lora_modules),
     )
     return ServingEngine(cfg)
 
@@ -413,6 +444,10 @@ def parse_args(argv=None) -> argparse.Namespace:
                    choices=["auto", "window", "paged", "xla", "pallas"])
     p.add_argument("--no-warmup", action="store_true",
                    help="Skip AOT warmup compilation at startup")
+    p.add_argument("--lora-modules", nargs="*", default=[],
+                   metavar="NAME=PATH",
+                   help="LoRA adapters to serve (vLLM convention): "
+                        "requests with model=NAME get base + adapter")
     import os
 
     p.add_argument("--api-key", default=os.environ.get("VLLM_API_KEY"),

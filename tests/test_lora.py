@@ -1,0 +1,167 @@
+"""LoRA serving: per-request adapter selection in one mixed batch, PEFT
+checkpoint loading, prefix-cache isolation between adapters.
+
+Replaces the reference's LoRA story (LoraAdapter CRD + vLLM --enable-lora,
+reference helm/templates/loraadapter-crd.yaml:1-225) with in-engine JAX
+adapter application (production_stack_tpu/models/lora.py)."""
+
+import asyncio
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.engine import ServingEngine
+from production_stack_tpu.engine.sampling import SamplingParams
+from production_stack_tpu.models.config import resolve_model_config
+from production_stack_tpu.models.lora import (
+    LoRARegistry,
+    init_random_adapter,
+    load_peft_adapter,
+    lora_delta,
+)
+
+MC = resolve_model_config("tiny-llama")
+
+
+def _engine_with_adapters(n=2):
+    eng = ServingEngine(EngineConfig(
+        model="tiny-llama", max_model_len=256, num_kv_blocks=128,
+        num_decode_steps=4, dtype="float32",
+    ))
+    reg = LoRARegistry(MC, dtype=jnp.float32)
+    for i in range(n):
+        reg.add(init_random_adapter(
+            f"adapter-{i}", MC, jax.random.PRNGKey(100 + i), rank=4,
+            dtype=jnp.float32, scale=3.0,
+        ))
+    eng.lora_registry = reg
+    eng.runner.lora_stacks = reg.stacks()
+    return eng
+
+
+async def _gen(eng, adapter, prompt="the quick brown fox jumps over"):
+    toks = []
+    async for o in eng.generate(
+        prompt=prompt,
+        sampling=SamplingParams(temperature=0.0, max_tokens=12,
+                                ignore_eos=True),
+        lora_adapter=adapter,
+    ):
+        toks = o.token_ids
+    return toks
+
+
+@pytest.mark.asyncio
+async def test_adapters_produce_distinct_outputs_in_one_batch():
+    eng = _engine_with_adapters()
+    await eng.start()
+    try:
+        base, a0, a1 = await asyncio.gather(
+            _gen(eng, None), _gen(eng, "adapter-0"), _gen(eng, "adapter-1"),
+        )
+    finally:
+        await eng.stop()
+    assert base != a0
+    assert base != a1
+    assert a0 != a1
+
+
+@pytest.mark.asyncio
+async def test_adapter_results_stable_across_batching():
+    """Adapter rows must not perturb co-batched base rows, and an adapter's
+    output must not depend on what it was batched with."""
+    eng = _engine_with_adapters()
+    await eng.start()
+    try:
+        base_alone = await _gen(eng, None)
+        a0_alone = await _gen(eng, "adapter-0")
+        base_mixed, a0_mixed = await asyncio.gather(
+            _gen(eng, None), _gen(eng, "adapter-0"),
+        )
+    finally:
+        await eng.stop()
+    assert base_alone == base_mixed
+    assert a0_alone == a0_mixed
+
+
+@pytest.mark.asyncio
+async def test_prefix_cache_not_shared_across_adapters():
+    """KV computed under one adapter must never be reused for another:
+    sequential identical prompts under different adapters still produce the
+    single-adapter outputs (a shared prefix would corrupt them)."""
+    eng = _engine_with_adapters()
+    await eng.start()
+    try:
+        a0_first = await _gen(eng, "adapter-0")
+        a1_after = await _gen(eng, "adapter-1")   # same prompt, other adapter
+        base_after = await _gen(eng, None)
+    finally:
+        await eng.stop()
+    eng2 = _engine_with_adapters()
+    await eng2.start()
+    try:
+        a1_fresh = await _gen(eng2, "adapter-1")
+        base_fresh = await _gen(eng2, None)
+    finally:
+        await eng2.stop()
+    assert a0_first != a1_after
+    assert a1_after == a1_fresh
+    assert base_after == base_fresh
+
+
+@pytest.mark.asyncio
+async def test_unknown_adapter_rejected():
+    eng = _engine_with_adapters()
+    await eng.start()
+    try:
+        with pytest.raises(KeyError):
+            await _gen(eng, "nope")
+    finally:
+        await eng.stop()
+
+
+def test_peft_checkpoint_roundtrip(tmp_path):
+    """Write an HF-PEFT-format checkpoint, load it, check delta math."""
+    from safetensors.numpy import save_file
+
+    rank, d = 4, MC.hidden_size
+    h_dim = MC.num_heads * MC.head_dim_
+    rng = np.random.default_rng(0)
+    tensors = {}
+    for li in range(MC.num_layers):
+        prefix = f"base_model.model.model.layers.{li}.self_attn.q_proj"
+        tensors[f"{prefix}.lora_A.weight"] = rng.normal(
+            size=(rank, d)).astype(np.float32)         # [r, in] torch
+        tensors[f"{prefix}.lora_B.weight"] = rng.normal(
+            size=(h_dim, rank)).astype(np.float32)     # [out, r] torch
+    save_file(tensors, str(tmp_path / "adapter_model.safetensors"))
+    (tmp_path / "adapter_config.json").write_text(json.dumps({
+        "r": rank, "lora_alpha": 8, "target_modules": ["q_proj"],
+    }))
+
+    ad = load_peft_adapter("t", str(tmp_path), MC, dtype=jnp.float32)
+    assert ad.rank == rank
+    assert set(ad.layers) == {"wq"}
+    a, b = ad.layers["wq"]
+    assert a.shape == (MC.num_layers, d, rank)
+    assert b.shape == (MC.num_layers, rank, h_dim)
+    # delta == x @ A.T @ B.T * alpha/r for layer 0
+    x = rng.normal(size=(1, 3, d)).astype(np.float32)
+    torch_a = tensors["base_model.model.model.layers.0.self_attn.q_proj.lora_A.weight"]
+    torch_b = tensors["base_model.model.model.layers.0.self_attn.q_proj.lora_B.weight"]
+    want = x @ torch_a.T @ torch_b.T * (8 / rank)
+    reg = LoRARegistry(MC, dtype=jnp.float32)
+    reg.add(ad)
+    sa, sb = reg.stacks()["wq"]
+    got = lora_delta(jnp.asarray(x), sa[0], sb[0],
+                     jnp.asarray([1], jnp.int32))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-4)
+    # index 0 is the zero adapter
+    zero = lora_delta(jnp.asarray(x), sa[0], sb[0],
+                      jnp.asarray([0], jnp.int32))
+    assert np.all(np.asarray(zero) == 0)
