@@ -423,10 +423,24 @@ class ModelRunner:
         else:
             win_k = win_v = win_len = None
 
+        # Sequence-parallel first-chunk prefill rides ring attention over the
+        # sp mesh axis (models/llama.py); chunks with history keep the
+        # window path (the window segment has no ring formulation yet).
+        from production_stack_tpu.parallel.mesh import AXIS_SP
+
+        ring_mesh = None
+        if (
+            not has_window and t > 1
+            and self.mesh.shape[AXIS_SP] > 1
+            and t % self.mesh.shape[AXIS_SP] == 0
+            and self.model_config.arch == "llama"
+        ):
+            ring_mesh = self.mesh
         hidden, k_new, v_new = self._forward(
             params, mc, token_ids, positions, chunk_lens,
             win_k, win_v, win_len,
             act_sharding=self._act_sharding, lora=lora,
+            ring_mesh=ring_mesh,
         )
         logit_idx = jnp.maximum(chunk_lens - 1, 0)
         last_hidden = hidden[jnp.arange(b), logit_idx]            # [b, D]

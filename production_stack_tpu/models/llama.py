@@ -102,6 +102,7 @@ def _layer_body(
     paged=None,               # (pool_k, pool_v, block_tables, kv_lens,
     layer_idx=None,           #  block_size, interpret) + scan layer index
     lora=None,                # (adapter_idx [B], {target: (A, B)} ONE layer)
+    ring_mesh=None,           # Mesh with sp>1: first-chunk prefill rings
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     b, t, d = hidden.shape
     h, hkv, dh = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
@@ -129,7 +130,18 @@ def _layer_body(
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
-    if paged is not None:
+    if ring_mesh is not None and t > 1 and win_k is None and ring_k is None:
+        # Sequence-parallel prefill: the chunk is pure causal self-attention
+        # (no history window, no intra-dispatch ring buffer), computed
+        # exactly by ring attention over the sp axis — KV shards stream
+        # around the ICI ring while each chip holds O(T/sp) tokens
+        # (ops/ring_attention.py). Padding rows/tokens carry positions
+        # beyond every real token of their row, so causal masking by
+        # absolute position excludes them as keys.
+        from production_stack_tpu.ops.ring_attention import ring_attention
+
+        attn = ring_attention(q, k, v, positions, ring_mesh)
+    elif paged is not None:
         # Paged decode (T == 1): the pool segment runs in the Pallas
         # flash-decode kernel directly against this layer of the stacked HBM
         # pool (no gathered window copy); the intra-dispatch ring + the
@@ -190,6 +202,7 @@ def forward(
     paged=None,  # (pool_k [L,Hkv,S,Dh], pool_v, block_tables [B,Mb],
                  #  kv_lens [B], block_size, interpret) — paged decode path
     lora=None,   # (adapter_idx [B], {target: (A [L,Na,in,r], B [L,Na,r,out])})
+    ring_mesh=None,  # Mesh with sp>1: first-chunk prefill uses ring attention
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Returns (hidden [B,T,D], k_new [L,Hkv,B,T,Dh], v_new [L,Hkv,B,T,Dh]).
 
@@ -237,7 +250,7 @@ def forward(
         h_out, k_l, v_l = _layer_body(
             cfg, h_carry, lp, cos, sin, positions, chunk_lens,
             wk, wv, win_len, rk, rv, ring_pos,
-            paged=paged, layer_idx=li, lora=lo,
+            paged=paged, layer_idx=li, lora=lo, ring_mesh=ring_mesh,
         )
         return h_out, (k_l, v_l)
 

@@ -92,6 +92,7 @@ def forward(
     act_sharding=None,
     paged=None,
     lora=None,
+    ring_mesh=None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Same contract as models/llama.py:forward (see its docstring).
     The paged (Pallas flash-decode) path is llama-family only: OPT head_dim

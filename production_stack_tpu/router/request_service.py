@@ -27,6 +27,7 @@ from production_stack_tpu.router.stats import (
     get_request_stats_monitor,
 )
 from production_stack_tpu.protocols import ErrorResponse, random_uuid
+from production_stack_tpu.tracing import get_tracer
 from production_stack_tpu.utils import init_logger
 
 logger = init_logger(__name__)
@@ -99,10 +100,25 @@ async def route_general_request(
     )
     logger.debug("Routing request %s for model %s to %s (%.1f ms)",
                  request_id, model, backend_url, (route_time - in_time) * 1e3)
-    return await proxy_request(
-        request, backend_url, endpoint, json.dumps(body).encode(), request_id,
-        body=body,
-    )
+    tracer = get_tracer("pstpu-router")
+    if tracer is None:
+        return await proxy_request(
+            request, backend_url, endpoint, json.dumps(body).encode(),
+            request_id, body=body,
+        )
+    # One span per routed request; its context propagates to the engine via
+    # the W3C traceparent header (reference tutorials/12-distributed-tracing.md).
+    with tracer.span(
+        f"router.route {endpoint}",
+        parent=request.headers.get("traceparent"),
+        attributes={"backend": backend_url, "model": model,
+                    "request.id": request_id,
+                    "queueing.delay_ms": (route_time - in_time) * 1e3},
+    ) as span:
+        return await proxy_request(
+            request, backend_url, endpoint, json.dumps(body).encode(),
+            request_id, body=body, traceparent=span.traceparent,
+        )
 
 
 async def proxy_request(
@@ -112,6 +128,7 @@ async def proxy_request(
     payload: bytes,
     request_id: str,
     body: Optional[dict] = None,
+    traceparent: Optional[str] = None,
 ) -> web.StreamResponse:
     """Stream the backend response through to the client."""
     app = request.app
@@ -123,6 +140,8 @@ async def proxy_request(
     auth = request.headers.get("Authorization")
     if auth:
         headers["Authorization"] = auth
+    if traceparent:
+        headers["traceparent"] = traceparent
 
     response: Optional[web.StreamResponse] = None
     try:

@@ -80,6 +80,29 @@ class APIServer:
     # ----------------------------------------------------------------- routes
     def build_app(self) -> web.Application:
         @web.middleware
+        async def trace(request: web.Request, handler):
+            # Continue the router's trace via the W3C traceparent header
+            # (production_stack_tpu/tracing.py; enabled by the standard
+            # OTEL_EXPORTER_OTLP_ENDPOINT / OTEL_SERVICE_NAME env vars —
+            # reference tutorials/12-distributed-tracing.md contract).
+            from production_stack_tpu.tracing import get_tracer
+
+            tracer = get_tracer("pstpu-engine")
+            if tracer is None or not request.path.startswith("/v1"):
+                return await handler(request)
+            with tracer.span(
+                f"engine {request.path}",
+                parent=request.headers.get("traceparent"),
+                attributes={"http.method": request.method,
+                            "model": self.model_name},
+            ) as span:
+                resp = await handler(request)
+                span.attributes["http.status_code"] = getattr(
+                    resp, "status", 0
+                )
+                return resp
+
+        @web.middleware
         async def auth(request: web.Request, handler):
             if self.api_key and (request.path.startswith("/v1")
                                  or request.path == "/rerank"):
@@ -93,7 +116,7 @@ class APIServer:
             return await handler(request)
 
         app = web.Application(client_max_size=64 * 1024 * 1024,
-                              middlewares=[auth])
+                              middlewares=[trace, auth])
 
         async def on_startup(app):
             await self.engine.start()

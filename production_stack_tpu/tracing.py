@@ -1,0 +1,210 @@
+"""Distributed tracing: OTLP/HTTP export + W3C trace-context propagation.
+
+Parity with the reference's tracing story (reference
+tutorials/12-distributed-tracing.md:1-80: engines configured via
+``OTEL_SERVICE_NAME`` / ``OTEL_EXPORTER_OTLP_ENDPOINT`` exporting to an
+OpenTelemetry collector), dependency-free: spans are exported as
+OTLP/HTTP **JSON** (the protocol's official JSON mapping) from a background
+thread, and cross-service context rides the W3C ``traceparent`` header —
+the router starts a trace per request and the engine continues it, so one
+trace covers route -> proxy -> engine handling.
+
+Enabled iff ``OTEL_EXPORTER_OTLP_ENDPOINT`` is set; otherwise every call is
+a no-op with zero overhead beyond a None check.
+"""
+
+import json
+import os
+import queue
+import secrets
+import threading
+import time
+import urllib.request
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+_FLUSH_INTERVAL_S = 2.0
+_MAX_BATCH = 256
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: str                 # 32 hex chars
+    span_id: str                  # 16 hex chars
+    parent_span_id: Optional[str] = None
+    start_ns: int = 0
+    end_ns: int = 0
+    attributes: Dict[str, object] = field(default_factory=dict)
+    status_ok: bool = True
+
+    @property
+    def traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+
+def parse_traceparent(header: Optional[str]):
+    """-> (trace_id, parent_span_id) or None (W3C trace-context v00)."""
+    if not header:
+        return None
+    parts = header.split("-")
+    if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+        return None
+    return parts[1], parts[2]
+
+
+class Tracer:
+    """Per-process tracer with a background OTLP/HTTP JSON exporter."""
+
+    def __init__(self, service_name: str, endpoint: str):
+        self.service_name = service_name
+        self.endpoint = endpoint.rstrip("/")
+        self._queue: "queue.Queue[Span]" = queue.Queue(maxsize=4096)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._export_loop, daemon=True, name="otlp-exporter"
+        )
+        self._thread.start()
+        logger.info("Tracing enabled: service=%s endpoint=%s",
+                    service_name, self.endpoint)
+
+    # ------------------------------------------------------------------ spans
+    def start_span(self, name: str, parent: Optional[str] = None,
+                   attributes: Optional[Dict] = None) -> Span:
+        """``parent`` is an incoming traceparent header (or None to start a
+        new trace)."""
+        ctx = parse_traceparent(parent)
+        if ctx:
+            trace_id, parent_id = ctx
+        else:
+            trace_id, parent_id = secrets.token_hex(16), None
+        return Span(
+            name=name, trace_id=trace_id, span_id=secrets.token_hex(8),
+            parent_span_id=parent_id, start_ns=time.time_ns(),
+            attributes=dict(attributes or {}),
+        )
+
+    def end_span(self, span: Span, ok: bool = True) -> None:
+        span.end_ns = time.time_ns()
+        span.status_ok = ok
+        try:
+            self._queue.put_nowait(span)
+        except queue.Full:
+            pass  # tracing must never block serving
+
+    @contextmanager
+    def span(self, name: str, parent: Optional[str] = None,
+             attributes: Optional[Dict] = None):
+        s = self.start_span(name, parent, attributes)
+        try:
+            yield s
+        except Exception:
+            self.end_span(s, ok=False)
+            raise
+        self.end_span(s, ok=True)
+
+    # ----------------------------------------------------------------- export
+    def _export_loop(self) -> None:
+        while not self._stop.is_set():
+            batch: List[Span] = []
+            try:
+                batch.append(self._queue.get(timeout=_FLUSH_INTERVAL_S))
+            except queue.Empty:
+                continue
+            while len(batch) < _MAX_BATCH:
+                try:
+                    batch.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
+            try:
+                self._post(batch)
+            except Exception as e:  # noqa: BLE001 — dropped batch, keep going
+                logger.debug("OTLP export failed: %s", e)
+
+    def _post(self, spans: List[Span]) -> None:
+        body = json.dumps(self._otlp_payload(spans)).encode()
+        req = urllib.request.Request(
+            f"{self.endpoint}/v1/traces", data=body,
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        urllib.request.urlopen(req, timeout=5).read()
+
+    def _otlp_payload(self, spans: List[Span]) -> dict:
+        def attr(k, v):
+            if isinstance(v, bool):
+                return {"key": k, "value": {"boolValue": v}}
+            if isinstance(v, int):
+                return {"key": k, "value": {"intValue": str(v)}}
+            if isinstance(v, float):
+                return {"key": k, "value": {"doubleValue": v}}
+            return {"key": k, "value": {"stringValue": str(v)}}
+
+        return {"resourceSpans": [{
+            "resource": {"attributes": [
+                attr("service.name", self.service_name),
+            ]},
+            "scopeSpans": [{
+                "scope": {"name": "production_stack_tpu"},
+                "spans": [{
+                    "traceId": s.trace_id,
+                    "spanId": s.span_id,
+                    **({"parentSpanId": s.parent_span_id}
+                       if s.parent_span_id else {}),
+                    "name": s.name,
+                    "kind": 2,  # SERVER
+                    "startTimeUnixNano": str(s.start_ns),
+                    "endTimeUnixNano": str(s.end_ns),
+                    "attributes": [attr(k, v)
+                                   for k, v in s.attributes.items()],
+                    "status": {"code": 1 if s.status_ok else 2},
+                } for s in spans],
+            }],
+        }]}
+
+    def close(self) -> None:
+        self._stop.set()
+        # drain what's queued
+        batch: List[Span] = []
+        while True:
+            try:
+                batch.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        if batch:
+            try:
+                self._post(batch)
+            except Exception:  # noqa: BLE001
+                pass
+
+
+_tracer: Optional[Tracer] = None
+_init_done = False
+
+
+def get_tracer(default_service: str = "production-stack-tpu") -> Optional[Tracer]:
+    """Process singleton, configured from the standard OTEL env vars
+    (OTEL_EXPORTER_OTLP_ENDPOINT enables; OTEL_SERVICE_NAME names)."""
+    global _tracer, _init_done
+    if not _init_done:
+        _init_done = True
+        endpoint = os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT")
+        if endpoint:
+            _tracer = Tracer(
+                os.environ.get("OTEL_SERVICE_NAME", default_service),
+                endpoint,
+            )
+    return _tracer
+
+
+def reset_tracer() -> None:
+    """Test seam: drop the singleton so env changes take effect."""
+    global _tracer, _init_done
+    if _tracer is not None:
+        _tracer.close()
+    _tracer = None
+    _init_done = False

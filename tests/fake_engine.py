@@ -27,6 +27,7 @@ class FakeEngine:
         self.prefix_queries = 0
         self.kv_usage = 0.0
         self.requests_seen = []     # (endpoint, body) tuples for assertions
+        self.headers_seen = []      # request headers per completion call
 
     def build_app(self) -> web.Application:
         app = web.Application()
@@ -68,6 +69,7 @@ class FakeEngine:
         self.requests_seen.append(
             ("/v1/chat/completions" if chat else "/v1/completions", body)
         )
+        self.headers_seen.append(dict(request.headers))
         n = int(body.get("max_tokens") or self.max_tokens_default)
         stream = bool(body.get("stream", False))
         self.running += 1

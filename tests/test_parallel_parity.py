@@ -1,0 +1,85 @@
+"""Multi-chip numerical parity on the virtual 8-device CPU mesh (VERDICT r2
+weak #11 / next #9): tensor-parallel and sequence-parallel engines must
+produce the SAME greedy tokens as the single-chip engine, and sp>1 prefill
+must actually execute the ring-attention path (not just a sharding
+constraint)."""
+
+import asyncio
+
+import pytest
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.engine import ServingEngine
+from production_stack_tpu.engine.sampling import SamplingParams
+
+PROMPTS = [
+    "the quick brown fox jumps over the lazy dog and keeps running " * 3,
+    "pack my box with five dozen liquor jugs while the band plays on " * 2,
+    "sphinx of black quartz judge my vow said the typesetter quietly",
+]
+
+
+async def _generate_all(engine, prompts, max_tokens=16):
+    async def one(p):
+        toks = []
+        async for o in engine.generate(
+            prompt=p,
+            sampling=SamplingParams(temperature=0.0, max_tokens=max_tokens,
+                                    ignore_eos=True),
+        ):
+            toks = o.token_ids
+        return toks
+
+    return await asyncio.gather(*[one(p) for p in prompts])
+
+
+async def _run_engine(tp=1, sp=1, model="tiny-llama-8kv"):
+    cfg = EngineConfig(
+        model=model, max_model_len=512, num_kv_blocks=256,
+        num_decode_steps=4, dtype="float32",
+        tensor_parallel_size=tp, sequence_parallel_size=sp,
+        max_num_batched_tokens=512,
+    )
+    eng = ServingEngine(cfg)
+    await eng.start()
+    try:
+        return await _generate_all(eng, PROMPTS)
+    finally:
+        await eng.stop()
+
+
+@pytest.mark.asyncio
+async def test_tp4_matches_tp1_greedy():
+    """tp=4 shards heads + KV pool over 4 devices; greedy tokens must equal
+    the unsharded engine's (float32: exact collectives, no tie noise)."""
+    base = await _run_engine(tp=1)
+    tp4 = await _run_engine(tp=4)
+    assert base == tp4
+
+
+@pytest.mark.asyncio
+async def test_sp2_matches_sp1_and_runs_ring_attention(monkeypatch):
+    """sp=2 shards prefill tokens over 2 devices; the first-chunk prefill
+    must go through ops/ring_attention.ring_attention and match sp=1."""
+    import production_stack_tpu.ops.ring_attention as ra
+
+    calls = {"n": 0}
+    orig = ra.ring_attention
+
+    def spy(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(ra, "ring_attention", spy)
+    base = await _run_engine(sp=1)
+    assert calls["n"] == 0
+    sp2 = await _run_engine(sp=2)
+    assert calls["n"] > 0, "sp=2 prefill never executed the ring path"
+    assert base == sp2
+
+
+@pytest.mark.asyncio
+async def test_tp2_sp2_combined():
+    base = await _run_engine(tp=1, sp=1)
+    both = await _run_engine(tp=2, sp=2)
+    assert base == both

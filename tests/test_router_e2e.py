@@ -193,3 +193,61 @@ async def test_backend_down_returns_502():
         assert resp.status == 502
     finally:
         await _stop_stack(servers, client)
+
+
+async def test_router_emits_trace_spans_with_propagation(monkeypatch):
+    """OTEL env set -> each proxied request produces a router span whose
+    traceparent is forwarded to the engine (tutorial-12 contract)."""
+    import json as _json
+
+    from aiohttp import web as _web
+
+    from production_stack_tpu import tracing as _tracing
+
+    batches = []
+    collector = _web.Application()
+
+    async def _traces(req):
+        batches.append(_json.loads(await req.read()))
+        return _web.json_response({})
+
+    collector.router.add_post("/v1/traces", _traces)
+    csrv = TestServer(collector)
+    await csrv.start_server()
+    monkeypatch.setenv("OTEL_EXPORTER_OTLP_ENDPOINT",
+                       f"http://127.0.0.1:{csrv.port}")
+    monkeypatch.setenv("OTEL_SERVICE_NAME", "router-e2e")
+    _tracing.reset_tracer()
+    engines, servers, urls, client = await _start_stack(n_engines=1)
+    try:
+        resp = await client.post("/v1/completions", json={
+            "model": "m1", "prompt": "hello", "max_tokens": 2,
+        })
+        assert resp.status == 200
+        await resp.read()
+        # the engine saw the router's traceparent header
+        headers = engines[0].headers_seen[-1]
+        headers = {k.lower(): v for k, v in headers.items()}
+        assert "traceparent" in headers
+        # wait for the background exporter thread's periodic flush (its POST
+        # is served by the collector while this coroutine awaits)
+        for _ in range(100):
+            if batches:
+                break
+            await asyncio.sleep(0.1)
+        spans = [
+            sp
+            for b in batches
+            for rs in b["resourceSpans"]
+            for ss in rs["scopeSpans"]
+            for sp in ss["spans"]
+        ]
+        assert any(s["name"].startswith("router.route") for s in spans)
+        tp = headers["traceparent"]
+        router_span = next(s for s in spans
+                           if s["name"].startswith("router.route"))
+        assert router_span["traceId"] in tp
+    finally:
+        _tracing.reset_tracer()
+        await _stop_stack(servers, client)
+        await csrv.close()
