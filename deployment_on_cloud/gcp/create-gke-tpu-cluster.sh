@@ -1,0 +1,32 @@
+#!/bin/bash
+# Create a GKE cluster with a TPU v5e node pool sized for the serving stack
+# (cloud-deploy parity with reference deployment_on_cloud/gcp, targeting TPU
+# node pools instead of GPU ones).
+set -euo pipefail
+
+PROJECT="${PROJECT:?set PROJECT}"
+CLUSTER="${CLUSTER:-pstpu-serving}"
+REGION="${REGION:-us-west4}"
+ZONE="${ZONE:-us-west4-a}"
+# ct5lp-hightpu-1t = 1 v5e chip/node; ct5lp-hightpu-4t = 2x2 slice/node.
+TPU_MACHINE="${TPU_MACHINE:-ct5lp-hightpu-4t}"
+TPU_TOPOLOGY="${TPU_TOPOLOGY:-2x2}"
+TPU_NODES="${TPU_NODES:-2}"
+
+gcloud container clusters create "$CLUSTER" \
+  --project "$PROJECT" --zone "$ZONE" \
+  --num-nodes 1 --machine-type e2-standard-8 \
+  --release-channel regular
+
+gcloud container node-pools create tpu-pool \
+  --project "$PROJECT" --zone "$ZONE" --cluster "$CLUSTER" \
+  --machine-type "$TPU_MACHINE" \
+  --tpu-topology "$TPU_TOPOLOGY" \
+  --num-nodes "$TPU_NODES" \
+  --enable-autoscaling --min-nodes 1 --max-nodes 4
+
+gcloud container clusters get-credentials "$CLUSTER" \
+  --project "$PROJECT" --zone "$ZONE"
+
+echo "Cluster ready. Deploy the stack with:"
+echo "  helm install stack ./helm -f helm/examples/values-01-minimal-example.yaml"

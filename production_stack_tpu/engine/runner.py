@@ -663,10 +663,23 @@ class ModelRunner:
                                                sharding=a.sharding),
                 self.params,
             )
-            self._decode.lower(
-                params_spec, spec(NUM_SCALARS * b + b * mb), kv_spec, kv_spec,
-                b=b, mb=mb, num_steps=k,
-            ).compile()
+            from production_stack_tpu.engine.scheduler import (
+                INTERACTIVE_DECODE_STEPS,
+            )
+
+            # High-batch family at full K, plus the 1-2-interactive-stream
+            # family (scheduler caps K and rows bucket to 2 there) — the
+            # latency-sensitive case must not hit a cold compile.
+            decode_shapes = {(b, k)}
+            decode_shapes.add((
+                _bucket(2, 1, max(1, cfg.max_num_seqs)),
+                min(INTERACTIVE_DECODE_STEPS, k),
+            ))
+            for db, dk in decode_shapes:
+                self._decode.lower(
+                    params_spec, spec(NUM_SCALARS * db + db * mb), kv_spec,
+                    kv_spec, b=db, mb=mb, num_steps=dk,
+                ).compile()
             t = _bucket(cfg.max_num_batched_tokens, 16,
                         max(16, cfg.max_num_batched_tokens))
             for has_window, pb in ((False, 1), (True, b)):

@@ -357,6 +357,9 @@ def build_app(args) -> web.Application:
         await app["client_session"].close()
         get_engine_stats_scraper().close()
         get_service_discovery().close()
+        from production_stack_tpu.tracing import reset_tracer
+
+        reset_tracer()  # drains + posts any queued spans
 
     app.on_startup.append(on_startup)
     app.on_cleanup.append(on_cleanup)

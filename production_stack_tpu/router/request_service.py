@@ -100,24 +100,24 @@ async def route_general_request(
     )
     logger.debug("Routing request %s for model %s to %s (%.1f ms)",
                  request_id, model, backend_url, (route_time - in_time) * 1e3)
+    # One span per routed request (when tracing is enabled); its context
+    # propagates to the engine via the W3C traceparent header (reference
+    # tutorials/12-distributed-tracing.md).
+    import contextlib
+
     tracer = get_tracer("pstpu-router")
-    if tracer is None:
-        return await proxy_request(
-            request, backend_url, endpoint, json.dumps(body).encode(),
-            request_id, body=body,
-        )
-    # One span per routed request; its context propagates to the engine via
-    # the W3C traceparent header (reference tutorials/12-distributed-tracing.md).
-    with tracer.span(
+    span_cm = contextlib.nullcontext() if tracer is None else tracer.span(
         f"router.route {endpoint}",
         parent=request.headers.get("traceparent"),
         attributes={"backend": backend_url, "model": model,
                     "request.id": request_id,
                     "queueing.delay_ms": (route_time - in_time) * 1e3},
-    ) as span:
+    )
+    with span_cm as span:
         return await proxy_request(
             request, backend_url, endpoint, json.dumps(body).encode(),
-            request_id, body=body, traceparent=span.traceparent,
+            request_id, body=body,
+            traceparent=span.traceparent if span else None,
         )
 
 

@@ -123,6 +123,9 @@ class APIServer:
 
         async def on_cleanup(app):
             await self.engine.stop()
+            from production_stack_tpu.tracing import reset_tracer
+
+            reset_tracer()  # drains + posts any queued spans
 
         app.on_startup.append(on_startup)
         app.on_cleanup.append(on_cleanup)

@@ -49,13 +49,24 @@ class Span:
 
 
 def parse_traceparent(header: Optional[str]):
-    """-> (trace_id, parent_span_id) or None (W3C trace-context v00)."""
+    """-> (trace_id, parent_span_id) or None (W3C trace-context v00).
+
+    Strict: non-hex or all-zero ids are rejected (a malformed client header
+    must start a fresh trace, not poison an OTLP export batch — collectors
+    400 non-hex ids and the whole batch would be dropped)."""
     if not header:
         return None
     parts = header.split("-")
     if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
         return None
-    return parts[1], parts[2]
+    trace_id, span_id = parts[1].lower(), parts[2].lower()
+    try:
+        t, s = int(trace_id, 16), int(span_id, 16)
+    except ValueError:
+        return None
+    if t == 0 or s == 0:
+        return None
+    return trace_id, span_id
 
 
 class Tracer:

@@ -164,3 +164,38 @@ def test_preemption_under_kv_pressure():
     finally:
         loop.run_until_complete(engine.stop())
         loop.close()
+
+
+def test_interactive_decode_uses_short_bursts():
+    """1-2 running streams cap the fused-scan length at 8 so SSE clients see
+    sub-100ms bursts instead of num_decode_steps-token ones (r3)."""
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.kv_cache import BlockPoolManager
+    from production_stack_tpu.engine.sampling import SamplingParams
+    from production_stack_tpu.engine.scheduler import (
+        Scheduler,
+        Sequence,
+        SequenceStatus,
+    )
+
+    cfg = EngineConfig(model="tiny-llama", max_model_len=256,
+                       num_decode_steps=32)
+    bm = BlockPoolManager(64, cfg.block_size, True)
+
+    def running_seq(i):
+        seq = Sequence(request_id=f"r{i}", prompt_token_ids=[1, 2, 3],
+                       sampling=SamplingParams(max_tokens=100))
+        seq.status = SequenceStatus.RUNNING
+        seq.num_computed_tokens = 3
+        seq.block_ids = list(bm.allocate_blocks(1))
+        return seq
+
+    sched = Scheduler(cfg, bm)
+    sched.running = [running_seq(0)]
+    batch = sched._schedule_decode()
+    assert batch.num_steps <= 8
+
+    sched2 = Scheduler(cfg, bm)
+    sched2.running = [running_seq(i) for i in range(1, 9)]
+    batch2 = sched2._schedule_decode()
+    assert batch2.num_steps > 8
