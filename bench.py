@@ -212,7 +212,10 @@ def main():
                          "tiny-llama on CPU)")
     ap.add_argument("--users", type=int, default=16)
     ap.add_argument("--rounds", type=int, default=4)
-    ap.add_argument("--prompt-len", type=int, default=600)
+    # ~150 words ~= a 1000-token system prompt under the byte-level fallback
+    # tokenizer — the reference workload's system prompt size
+    # (reference benchmarks/multi-round-qa/run.sh: system prompt 1000 tok).
+    ap.add_argument("--prompt-len", type=int, default=150)
     ap.add_argument("--max-tokens", type=int, default=64)
     # 8192 by default: the engine serves long-context configs without a
     # window-copy memory wall (paged decode; bucketed window for head_dim<128
