@@ -216,7 +216,9 @@ def main():
     # tokenizer — the reference workload's system prompt size
     # (reference benchmarks/multi-round-qa/run.sh: system prompt 1000 tok).
     ap.add_argument("--prompt-len", type=int, default=150)
-    ap.add_argument("--max-tokens", type=int, default=64)
+    # 100-token answers: the reference workload's answer size
+    # (reference benchmarks/multi-round-qa/run.sh).
+    ap.add_argument("--max-tokens", type=int, default=100)
     # 8192 by default: the engine serves long-context configs without a
     # window-copy memory wall (paged decode; bucketed window for head_dim<128
     # models) — VERDICT r2 weak #2 demanded the bench stop pinning 1024.

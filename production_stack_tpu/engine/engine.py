@@ -248,6 +248,9 @@ class ServingEngine:
             batch = self.scheduler.schedule()
             if batch is None:
                 self._new_work.clear()
+                # Idle: drop the persistent decode window so its (up to
+                # window-budget-sized) device buffers don't pin HBM.
+                self.runner._win_cache = None
                 if not self.scheduler.has_work():
                     try:
                         await asyncio.wait_for(self._new_work.wait(), timeout=1.0)

@@ -29,9 +29,11 @@ from production_stack_tpu.utils import init_logger, pow2_bucket as _bucket
 
 logger = init_logger(__name__)
 
-# Fused-scan length cap when only 1-2 streams are active (SSE burst size /
-# latency tradeoff); runner.warmup() AOT-compiles this shape family too.
-INTERACTIVE_DECODE_STEPS = 8
+# Fused-scan length grades with the number of active streams (SSE burst
+# size / per-dispatch fixed cost tradeoff); runner.warmup() AOT-compiles
+# each shape family. (max_running_bound, K_cap) pairs, ascending.
+DECODE_STEP_TIERS = ((2, 8), (8, 32))
+INTERACTIVE_DECODE_STEPS = DECODE_STEP_TIERS[0][1]
 
 
 class SequenceStatus(enum.Enum):
@@ -259,8 +261,12 @@ class Scheduler:
             # its bucketed size within the window budget too.
             has_window = any(c.num_computed_tokens > 0 for c in cands[:n])
             mb_need = max(len(c.block_ids) for c in cands[:n])
+            # The runner pads multi-row prefills to the max_prefill_seqs
+            # bucket (one compiled row family); budget the window at the
+            # PADDED row count or the cap is bypassed.
+            padded_rows = n if n == 1 else max(n, self.config.max_prefill_seqs)
             win_ok = not has_window or self._window_ok(
-                n, mb_need, self.prefill_window_budget
+                padded_rows, mb_need, self.prefill_window_budget
             )
             if n == 1 or (n * t_bucket <= budget and win_ok):
                 break
@@ -297,11 +303,13 @@ class Scheduler:
         # Streaming granularity (VERDICT r2 weak #5): the fused scan emits
         # tokens to clients once per dispatch, so K trades SSE burst size
         # against per-dispatch overhead. At high batch the aggregate
-        # throughput justifies long bursts; for 1-2 interactive streams the
+        # throughput justifies long bursts; with few interactive streams the
         # absolute throughput cost of short dispatches is small and latency
-        # dominates — cap K at 8 there.
-        if len(self.running) <= 2:
-            max_k = min(max_k, INTERACTIVE_DECODE_STEPS)
+        # dominates.
+        for bound, cap in DECODE_STEP_TIERS:
+            if len(self.running) <= bound:
+                max_k = min(max_k, cap)
+                break
         scheduled: List[Sequence] = []
         steps: List[int] = []
         for seq in list(self.running):

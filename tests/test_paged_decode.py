@@ -206,3 +206,45 @@ async def test_window_block_budget_splits_decode_batches():
         assert all(rows < 6 for kind, rows, _ in batches if kind == "decode")
     finally:
         await eng.stop()
+
+
+@pytest.mark.asyncio
+async def test_persistent_window_cache_reuse_and_correctness():
+    """Consecutive decode dispatches over the same rows reuse the cached
+    window (appending new KV) and still produce exactly the tokens a fresh
+    engine computes; interleaved arrivals invalidate the cache safely."""
+    cfg = dict(model="tiny-llama", max_model_len=256, num_kv_blocks=128,
+               attn_impl="window", num_decode_steps=4, dtype="float32")
+    prompts = [f"window cache request {i} " * (i + 2) for i in range(3)]
+    late = ["late arrival " * 3]
+
+    eng = ServingEngine(EngineConfig(**cfg))
+    uses = {"cached": 0, "fresh": 0}
+    orig = eng.runner._decode
+
+    def spy(*args, **kw):
+        uses["cached" if kw.get("use_cached_window") else "fresh"] += 1
+        return orig(*args, **kw)
+
+    eng.runner._decode = spy
+    await eng.start()
+    try:
+        # long generations -> many consecutive decode dispatches (K=4)
+        first = await _generate_all(eng, prompts, max_tokens=24)
+        # a different row set afterwards -> cache must not leak stale KV
+        second = await _generate_all(eng, late, max_tokens=8)
+    finally:
+        await eng.stop()
+    assert uses["cached"] > 0, "steady-state dispatches never reused the window"
+    assert uses["fresh"] > 0
+
+    # Fresh engine with no cache reuse across row sets: identical outputs.
+    eng2 = ServingEngine(EngineConfig(**cfg))
+    await eng2.start()
+    try:
+        ref = await _generate_all(eng2, prompts, max_tokens=24)
+        ref_second = await _generate_all(eng2, late, max_tokens=8)
+    finally:
+        await eng2.stop()
+    assert first == ref
+    assert second == ref_second
