@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Build every image the Helm chart references (helm/values.yaml:
+# routerSpec/cacheserverSpec/operatorSpec/loraController repositories and
+# the modelSpec engine repository used by helm/examples/*.yaml).
+#
+#   ./docker/build.sh [TAG] [REGISTRY_PREFIX]
+#
+# e.g. ./docker/build.sh v0.1.0 gcr.io/my-project  pushes nothing; add
+# `docker push` per image or use `--push` with buildx as needed.
+set -euo pipefail
+
+TAG="${1:-latest}"
+PREFIX="${2:-}"
+[ -n "$PREFIX" ] && PREFIX="${PREFIX%/}/"
+
+cd "$(dirname "$0")/.."
+
+build() {
+    local name="$1" dockerfile="$2"
+    echo "==> building ${PREFIX}production-stack-tpu/${name}:${TAG}"
+    docker build -f "docker/${dockerfile}" \
+        -t "${PREFIX}production-stack-tpu/${name}:${TAG}" .
+}
+
+build router          Dockerfile.router
+build engine          Dockerfile.engine
+build cache-server    Dockerfile.cache-server
+build lora-controller Dockerfile.lora-controller
+
+echo "All images built."

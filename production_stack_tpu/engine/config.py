@@ -94,9 +94,20 @@ class EngineConfig:
             supports_pallas_decode,
         )
 
+        # With tp>1 the KV pool is kv-head-sharded and the kernel runs under
+        # shard_map over the tp axis, which is exact only when both head
+        # counts divide tp (parallel/sharding.py falls back to replication
+        # otherwise and the shard_map specs would be wrong).
+        tp = self.tensor_parallel_size
+        tp_ok = (
+            tp == 1
+            or (model_config.num_kv_heads % tp == 0
+                and model_config.num_heads % tp == 0)
+        )
         supported = (
             model_config.arch == "llama"
             and supports_pallas_decode(model_config.head_dim_, self.block_size)
+            and tp_ok
         )
         v = self.attn_impl
         if v in ("xla", "window"):
@@ -107,10 +118,13 @@ class EngineConfig:
                     f"attn_impl={v!r} requires a llama-family model whose "
                     f"head_dim divides or is a multiple of 128 (lane "
                     f"packing), with block_size dividing the superpage and "
-                    f"divisible by the pack factor; got "
+                    f"divisible by the pack factor, and (for tp>1) head "
+                    f"counts divisible by tensor_parallel_size; got "
                     f"arch={model_config.arch} "
                     f"head_dim={model_config.head_dim_} "
-                    f"block_size={self.block_size}"
+                    f"block_size={self.block_size} "
+                    f"heads={model_config.num_heads}/"
+                    f"{model_config.num_kv_heads} tp={tp}"
                 )
             return "paged"
         if v != "auto":

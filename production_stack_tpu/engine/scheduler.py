@@ -36,6 +36,18 @@ DECODE_STEP_TIERS = ((2, 8), (8, 32))
 INTERACTIVE_DECODE_STEPS = DECODE_STEP_TIERS[0][1]
 
 
+def decode_step_cap(num_streams: int, num_decode_steps: int) -> int:
+    """Fused-scan K cap for ``num_streams`` concurrent rows. The SINGLE
+    grading rule shared by the scheduler (pre-loop + dispatched-rows
+    re-grade) and runner.warmup — a tier change updated in only one place
+    would silently re-introduce mid-serving cold compiles."""
+    cap = max(1, num_decode_steps)
+    for bound, tier_cap in DECODE_STEP_TIERS:
+        if num_streams <= bound:
+            return min(cap, tier_cap)
+    return cap
+
+
 class SequenceStatus(enum.Enum):
     WAITING = "waiting"
     RUNNING = "running"
@@ -142,6 +154,11 @@ class Scheduler:
         self.running: List[Sequence] = []
         self.seqs: Dict[str, Sequence] = {}
         self.num_preemptions_total = 0
+        # Decode-priority row: a row the window budget skipped last dispatch
+        # decodes FIRST next dispatch (as the leading row it schedules
+        # unconditionally). Held as the Sequence itself, not an index — the
+        # running list churns between dispatches (advisor r3 finding).
+        self._decode_first: Optional[Sequence] = None
 
     def _window_ok(self, rows: int, max_blocks: int, budget: int) -> bool:
         cfg = self.config
@@ -299,20 +316,31 @@ class Scheduler:
         if not self.running:
             return None
         bs = self.config.block_size
-        max_k = max(1, self.config.num_decode_steps)
         # Streaming granularity (VERDICT r2 weak #5): the fused scan emits
         # tokens to clients once per dispatch, so K trades SSE burst size
         # against per-dispatch overhead. At high batch the aggregate
         # throughput justifies long bursts; with few interactive streams the
         # absolute throughput cost of short dispatches is small and latency
         # dominates.
-        for bound, cap in DECODE_STEP_TIERS:
-            if len(self.running) <= bound:
-                max_k = min(max_k, cap)
-                break
+        max_k = decode_step_cap(
+            len(self.running), self.config.num_decode_steps
+        )
         scheduled: List[Sequence] = []
         steps: List[int] = []
-        for seq in list(self.running):
+        snapshot = list(self.running)
+        # Iteration starts at the row the window budget skipped last
+        # dispatch, if any (order stays stable otherwise, preserving the
+        # runner's persistent decode-window cache, which keys on identical
+        # row order).
+        ofs = 0
+        if self._decode_first is not None:
+            try:
+                ofs = snapshot.index(self._decode_first)
+            except ValueError:
+                pass  # finished/preempted since; normal order
+            self._decode_first = None
+        first_skipped: Optional[Sequence] = None
+        for seq in snapshot[ofs:] + snapshot[:ofs]:
             if seq not in self.running:
                 # Preempted by an earlier iteration of this same pass.
                 continue
@@ -351,20 +379,45 @@ class Scheduler:
             if scheduled and not self._window_ok(
                 len(scheduled) + 1, mb_next, self.decode_window_budget
             ):
+                if first_skipped is None:
+                    first_skipped = seq
                 continue  # window budget full; this row decodes next dispatch
             scheduled.append(seq)
             steps.append(min(want, avail))
+        if first_skipped is not None and first_skipped in self.running:
+            # Next dispatch starts AT the skipped row (it schedules
+            # unconditionally as the first row), so a budget-bumped long row
+            # cannot be starved by the same earlier rows forever.
+            self._decode_first = first_skipped
         if not scheduled:
             return None
+        # Re-grade K by the rows actually DISPATCHED: when the window budget
+        # skipped rows, len(running) > len(scheduled) and the pre-loop tier
+        # would emit a (small-rows, high-K) shape family that warmup never
+        # compiled (warmup keys tiers by row bucket).
+        max_k = min(
+            max_k,
+            decode_step_cap(len(scheduled), self.config.num_decode_steps),
+        )
         # Scan length is the power-of-two bucket of the largest per-seq budget
         # (bounds the compile-cache like the batch/token buckets do).
         num_steps = 1
         while num_steps < max(steps):
             num_steps *= 2
         num_steps = min(num_steps, max_k)
+        # Return blocks over-reserved for the pre-regrade `want` (the
+        # allocation loop sized rows for up to the pre-loop max_k steps):
+        # under a tight pool they would otherwise sit unused this dispatch
+        # while starving prefill admissions.
+        for i, seq in enumerate(scheduled):
+            steps[i] = min(steps[i], num_steps)
+            need = (seq.num_computed_tokens + steps[i] - 1) // bs + 1
+            if len(seq.block_ids) > need:
+                self.block_manager.free_blocks(seq.block_ids[need:])
+                del seq.block_ids[need:]
         return ScheduledBatch(
             kind="decode", seqs=scheduled, num_steps=num_steps,
-            decode_steps=[min(s, num_steps) for s in steps],
+            decode_steps=steps,
         )
 
     def _pick_preemption_victim(self, exclude: Seq[Sequence]) -> Optional[Sequence]:

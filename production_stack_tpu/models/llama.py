@@ -100,7 +100,8 @@ def _layer_body(
     win_k, win_v, win_len,
     ring_k, ring_v, ring_pos,
     paged=None,               # (pool_k, pool_v, block_tables, kv_lens,
-    layer_idx=None,           #  block_size, interpret) + scan layer index
+    layer_idx=None,           #  block_size, interpret, tp_mesh|None)
+                              #  + scan layer index
     lora=None,                # (adapter_idx [B], {target: (A, B)} ONE layer)
     ring_mesh=None,           # Mesh with sp>1: first-chunk prefill rings
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
@@ -149,14 +150,25 @@ def _layer_body(
         # softmax stats. See ops/pallas/paged_attention.py.
         from production_stack_tpu.ops.pallas.paged_attention import (
             paged_flash_decode_stats,
+            paged_flash_decode_stats_tp,
         )
 
-        pool_k, pool_v, block_tables, kv_lens, block_size, interpret = paged
+        (pool_k, pool_v, block_tables, kv_lens, block_size, interpret,
+         tp_mesh) = paged
         q2 = q.reshape(b, h, dh)
-        out_p, m_p, l_p = paged_flash_decode_stats(
-            q2, pool_k, pool_v, block_tables, kv_lens, layer_idx,
-            block_size=block_size, interpret=interpret,
-        )
+        if tp_mesh is not None:
+            # TP>1: the pool is kv-head-sharded; run the kernel per-shard
+            # via shard_map (exact — heads are independent) instead of
+            # letting GSPMD all-gather the pool (advisor r3 high finding).
+            out_p, m_p, l_p = paged_flash_decode_stats_tp(
+                q2, pool_k, pool_v, block_tables, kv_lens, layer_idx,
+                tp_mesh, block_size=block_size, interpret=interpret,
+            )
+        else:
+            out_p, m_p, l_p = paged_flash_decode_stats(
+                q2, pool_k, pool_v, block_tables, kv_lens, layer_idx,
+                block_size=block_size, interpret=interpret,
+            )
         kc = k.transpose(2, 0, 1, 3)          # [Hkv, B, 1, Dh] current token
         vc = v.transpose(2, 0, 1, 3)
         self_bias = jnp.zeros((b, 1), jnp.float32)
@@ -200,7 +212,8 @@ def forward(
     *,
     act_sharding=None,
     paged=None,  # (pool_k [L,Hkv,S,Dh], pool_v, block_tables [B,Mb],
-                 #  kv_lens [B], block_size, interpret) — paged decode path
+                 #  kv_lens [B], block_size, interpret, tp_mesh|None)
+                 #  — paged decode path (tp_mesh set => shard_map over tp)
     lora=None,   # (adapter_idx [B], {target: (A [L,Na,in,r], B [L,Na,r,out])})
     ring_mesh=None,  # Mesh with sp>1: first-chunk prefill uses ring attention
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:

@@ -95,10 +95,11 @@ def forward(
     ring_mesh=None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Same contract as models/llama.py:forward (see its docstring).
-    The paged (Pallas flash-decode) path is llama-family only: OPT head_dim
-    (64) is below the kernel's 128-lane alignment, so ``paged`` must be None
-    (engine/config.py:resolved_attn_impl never selects it for OPT)."""
-    assert paged is None, "paged decode unsupported for OPT (head_dim < 128)"
+    The paged (Pallas flash-decode) path is llama-family-only BY POLICY
+    (engine/config.py:resolved_attn_impl requires arch == "llama"); the
+    kernel itself handles small head dims via lane packing, but this
+    forward never receives ``paged`` so it is asserted away."""
+    assert paged is None, "paged decode is llama-family only (policy)"
     assert lora is None, "LoRA serving is llama-family only"
     hidden = (
         params["embed"][token_ids] + params["pos_embed"][positions + _OPT_POS_OFFSET]

@@ -260,8 +260,8 @@ def paged_flash_decode_stats(
                 (1, h, dh), lambda i, *_: (i, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
-            pl.BlockSpec(memory_space=pltpu.ANY),  # pool stays off-chip;
-            pl.BlockSpec(memory_space=pltpu.ANY),  # kernel DMAs pages itself
+            pl.BlockSpec(memory_space=pl.ANY),  # pool stays off-chip;
+            pl.BlockSpec(memory_space=pl.ANY),  # kernel DMAs pages itself
         ],
         out_specs=[
             pl.BlockSpec(
@@ -301,6 +301,62 @@ def paged_flash_decode_stats(
         block_tables, kv_lens, q, kp, vp,
     )
     return out, m.reshape(b, h), l.reshape(b, h)
+
+
+def paged_flash_decode_stats_tp(
+    q: jax.Array,             # [B, H, Dh] decode queries (post-rope)
+    k_pool: jax.Array,        # [L, Hkv, num_slots, Dh] — Hkv sharded over tp
+    v_pool: jax.Array,
+    block_tables: jax.Array,  # [B, Mb] int32 (replicated)
+    kv_lens: jax.Array,       # [B] int32 (replicated)
+    layer_idx: jax.Array,
+    mesh,                     # jax.sharding.Mesh with a "tp" axis > 1
+    *,
+    block_size: int,
+    scale: Optional[float] = None,
+    interpret: bool = False,
+) -> tuple:
+    """TP-sharded pool-segment flash decode via shard_map over kv heads.
+
+    The KV pool is head-sharded over the tp mesh axis
+    (parallel/sharding.py:kv_pool_sharding) and pallas_call carries no GSPMD
+    partitioning rule, so calling the kernel directly under jit would force
+    an all-gather of the entire pool (advisor r3 high finding). Each kv
+    head's attention is independent, so running the kernel per-shard over
+    its local heads — queries head-sharded to match (GQA groups stay with
+    their kv head) — is exact and needs no collectives; the row-parallel
+    o-projection's psum downstream is unchanged.
+
+    Requires num_heads % tp == 0 and num_kv_heads % tp == 0 (enforced by
+    EngineConfig.resolved_attn_impl).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from production_stack_tpu.parallel.mesh import AXIS_TP
+
+    fn = functools.partial(
+        paged_flash_decode_stats,
+        block_size=block_size, scale=scale, interpret=interpret,
+    )
+    return jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(
+            P(None, AXIS_TP, None),        # q: heads sharded
+            P(None, AXIS_TP, None, None),  # pools: kv heads sharded
+            P(None, AXIS_TP, None, None),
+            P(None, None),                 # block tables replicated
+            P(None,),                      # kv lens replicated
+            P(None,),                      # layer index replicated
+        ),
+        out_specs=(
+            P(None, AXIS_TP, None),        # out [B, H, Dh]
+            P(None, AXIS_TP),              # m [B, H]
+            P(None, AXIS_TP),              # l [B, H]
+        ),
+        check_vma=False,
+    )(q, k_pool, v_pool, block_tables, kv_lens,
+      jnp.asarray(layer_idx, jnp.int32).reshape(1))
 
 
 @functools.partial(
