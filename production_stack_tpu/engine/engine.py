@@ -49,6 +49,10 @@ class RequestOutput:
     num_prompt_tokens: int = 0
     num_output_tokens: int = 0
     num_cached_tokens: int = 0
+    # When sampling.logprobs is set: one (chosen_logprob,
+    # [(token_id, logprob), ...top-k]) per output token, aligned with
+    # token_ids (None otherwise).
+    logprobs: Optional[List] = None
 
 
 @dataclass
@@ -265,7 +269,7 @@ class ServingEngine:
             self._step_counter += 1
             try:
                 t0 = time.monotonic()
-                next_tokens = await loop.run_in_executor(
+                next_tokens, logprob_lists = await loop.run_in_executor(
                     None, self.runner.execute, batch, step
                 )
                 if self._dispatch_log is not None:
@@ -284,7 +288,7 @@ class ServingEngine:
                 continue
             self.last_step_time = time.monotonic()
             produced, accepted = self.scheduler.update_after_step(
-                batch, next_tokens
+                batch, next_tokens, logprob_lists
             )
             self.generation_tokens_total += accepted
             for seq in produced:
@@ -353,6 +357,8 @@ class ServingEngine:
                     lo -= 1
                 self.generation_tokens_total -= len(toks) - lo
                 seq.output_token_ids = toks[:lo]
+                if seq.output_logprobs:
+                    del seq.output_logprobs[lo:]
                 if finished:
                     seq.status = SequenceStatus.FINISHED_STOPPED
                 else:
@@ -373,6 +379,10 @@ class ServingEngine:
             num_prompt_tokens=seq.num_prompt_tokens,
             num_output_tokens=len(seq.output_token_ids),
             num_cached_tokens=seq.num_cached_tokens,
+            logprobs=(
+                list(seq.output_logprobs)
+                if seq.sampling.logprobs is not None else None
+            ),
         ))
 
     # ------------------------------------------------------------------ stats

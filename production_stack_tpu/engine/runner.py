@@ -44,8 +44,21 @@ logger = init_logger(__name__)
 _SEED_MULT = np.uint32(1000003)
 _POS_SENTINEL = np.int32(2**30)  # ring_pos value for not-yet-written entries
 # int32 per-row scalar rows at the head of each packed host buffer; row 8 is
-# the LoRA adapter index (0 = base model).
-NUM_SCALARS = 9
+# the LoRA adapter index (0 = base model); rows 9/10 are the
+# presence/frequency penalties (floats bitcast).
+NUM_SCALARS = 11
+# Static buckets for the per-dispatch top-logprobs width: OpenAI completions
+# allows logprobs<=5, chat top_logprobs<=20; two buckets bound the compiled
+# variant count. 0 = the (default) no-logprobs variants.
+LOGPROB_BUCKETS = (8, 20)
+
+
+def logprobs_bucket(k: int) -> int:
+    """Smallest static top-k bucket covering a requested logprobs width."""
+    for b in LOGPROB_BUCKETS:
+        if k <= b:
+            return b
+    return LOGPROB_BUCKETS[-1]
 
 
 def _dtype(name: str):
@@ -183,7 +196,8 @@ class ModelRunner:
             self._act_sharding = None
         self._decode = jax.jit(
             self._decode_impl,
-            static_argnames=("b", "mb", "num_steps", "use_cached_window"),
+            static_argnames=("b", "mb", "num_steps", "use_cached_window",
+                             "has_penalties", "logprobs_k"),
             donate_argnums=(2, 3, 4, 5),
         )
         # Persistent decode window (window impl only): consecutive decode
@@ -194,7 +208,8 @@ class ModelRunner:
         self._win_cache = None
         self._prefill = jax.jit(
             self._prefill_impl,
-            static_argnames=("b", "t", "mb", "has_window"),
+            static_argnames=("b", "t", "mb", "has_window",
+                             "has_penalties", "logprobs_k"),
             donate_argnums=(2, 3),
         )
 
@@ -272,14 +287,24 @@ class ModelRunner:
 
     # ------------------------------------------------------------------ decode
     def _decode_impl(self, params, packed, kv_k, kv_v, win_k_in, win_v_in,
-                     *, b: int, mb: int, num_steps: int,
-                     use_cached_window: bool):
+                     counts0, *, b: int, mb: int, num_steps: int,
+                     use_cached_window: bool, has_penalties: bool = False,
+                     logprobs_k: int = 0):
         """One fused K-step decode dispatch.
 
-        packed: int32[b*(9+mb)] host buffer laid out as 9 per-row scalars
-        (tokens0, pos0, budget, seed_base, gen0, temps, top_k, top_p,
-        adapter — floats bitcast) followed by the [b, mb] block tables.
-        Everything else is derived here, on device.
+        packed: int32[b*(NUM_SCALARS+mb)] host buffer laid out as per-row
+        scalars (tokens0, pos0, budget, seed_base, gen0, temps, top_k,
+        top_p, adapter, presence, frequency — floats bitcast) followed by
+        the [b, mb] block tables. Everything else is derived here, on
+        device.
+
+        counts0: [b, V] int32 output-token occurrence counts when
+        ``has_penalties`` (threaded through the scan carry so mid-scan
+        tokens are penalized too); a [1, 1] dummy otherwise. With
+        ``logprobs_k`` > 0 the dispatch also returns per-step
+        (chosen_logprob [K, b], top_lp [K, b, k], top_ids [K, b, k]) from
+        the RAW logits. Both knobs are static so the default serving path
+        compiles no penalty/logprob code at all.
 
         win_k_in/win_v_in: the persistent window buffers [L, Hkv, b, mb*bs,
         Dh] (window impl with ``use_cached_window``): they already hold the
@@ -302,6 +327,8 @@ class ModelRunner:
         top_k = scalars[6]
         top_p = jax.lax.bitcast_convert_type(scalars[7], jnp.float32)
         adapter_idx = scalars[8]
+        presence = jax.lax.bitcast_convert_type(scalars[9], jnp.float32)
+        frequency = jax.lax.bitcast_convert_type(scalars[10], jnp.float32)
         lora = (adapter_idx, self.lora_stacks) if self.lora_stacks else None
         block_tables = packed[NUM_SCALARS * b:].reshape(b, mb)
 
@@ -345,9 +372,19 @@ class ModelRunner:
         ones = jnp.ones((b,), jnp.int32)
         max_len = cfg.max_model_len
 
-        def body(carry, xs):
-            toks, ring_k, ring_v, ring_pos = carry
-            j, seeds_j = xs
+        iota_rows = jnp.arange(b, dtype=jnp.int32)
+        # The loop runs EXACTLY the steps some row still needs — K is only
+        # the compiled (buffer-shape) bound. A drain-tail dispatch whose
+        # rows all have e.g. 36 steps left executes 36 iterations inside
+        # the K=64 family instead of computing 28 discarded steps (22% of
+        # the bench round's decode time, r4 dispatch-log profiling).
+        n_active = jnp.max(
+            jnp.minimum(budget, num_steps)
+        ).astype(jnp.int32)
+
+        def body(carry, j):
+            toks, ring_k, ring_v, ring_pos, counts = carry
+            seeds_j = seed_steps[j]
             positions = jnp.minimum(pos0 + j, max_len - 1)[:, None]
             hidden, k_new, v_new = self._forward(
                 params, mc, toks[:, None], positions, ones,
@@ -355,7 +392,25 @@ class ModelRunner:
                 paged=paged, lora=lora,
             )
             logits = self._logits_fn(params, mc, hidden[:, 0])
-            nxt = sample_tokens(logits, temps, top_k, top_p, seeds_j)
+            if has_penalties:
+                from production_stack_tpu.engine.sampling import (
+                    apply_penalties,
+                )
+
+                eff = apply_penalties(logits, counts, presence, frequency)
+            else:
+                eff = logits
+            nxt = sample_tokens(eff, temps, top_k, top_p, seeds_j)
+            if has_penalties:
+                counts = counts.at[iota_rows, nxt].add(1)
+            if logprobs_k:
+                from production_stack_tpu.engine.sampling import (
+                    compute_logprobs,
+                )
+
+                lp = compute_logprobs(logits, nxt, logprobs_k)
+            else:
+                lp = None
             # Append this step's KV (+ its position) to the ring at index j.
             ring_k = jax.lax.dynamic_update_slice(
                 ring_k, k_new, (0, 0, 0, j, 0)
@@ -366,12 +421,38 @@ class ModelRunner:
             ring_pos = jax.lax.dynamic_update_slice(
                 ring_pos, positions, (0, j)
             )
-            return (nxt.astype(jnp.int32), ring_k, ring_v, ring_pos), nxt
+            return (
+                nxt.astype(jnp.int32), ring_k, ring_v, ring_pos, counts
+            ), nxt, lp
 
-        (_, ring_k, ring_v, _), toks_all = jax.lax.scan(
-            body, (tokens0, ring_k0, ring_v0, ring_pos0),
-            (k_iota, seed_steps),
+        def loop_body(state):
+            j, carry, toks_all, lp_bufs = state
+            carry, nxt, lp = body(carry, j)
+            toks_all = toks_all.at[j].set(nxt)
+            if logprobs_k:
+                lp_bufs = (
+                    lp_bufs[0].at[j].set(lp[0]),
+                    lp_bufs[1].at[j].set(lp[1]),
+                    lp_bufs[2].at[j].set(lp[2]),
+                )
+            return j + 1, carry, toks_all, lp_bufs
+
+        carry0 = (tokens0, ring_k0, ring_v0, ring_pos0, counts0)
+        toks_buf0 = jnp.zeros((num_steps, b), jnp.int32)
+        lp_bufs0 = (
+            jnp.zeros((num_steps, b), jnp.float32),
+            jnp.zeros((num_steps, b, logprobs_k), jnp.float32),
+            jnp.zeros((num_steps, b, logprobs_k), jnp.int32),
+        ) if logprobs_k else ()
+        _, (_, ring_k, ring_v, _, _), toks_all, lp_bufs = jax.lax.while_loop(
+            lambda st: st[0] < n_active,
+            loop_body,
+            (jnp.int32(0), carry0, toks_buf0, lp_bufs0),
         )
+        if logprobs_k:
+            lp_chosen, lp_top, lp_ids = lp_bufs
+        else:
+            lp_chosen, lp_top, lp_ids = None, None, None
 
         # ONE scatter writes the whole dispatch's KV back to the paged pool.
         flat_slots = slot_steps.reshape(-1)                       # [K*b]
@@ -396,8 +477,10 @@ class ModelRunner:
             win_v = win_v.reshape(nl, hkv, b * s_tot, dh).at[
                 :, :, widx.reshape(-1)
             ].set(v_flat, mode="drop").reshape(nl, hkv, b, s_tot, dh)
-            return toks_all, kv_k, kv_v, win_k, win_v             # [K, b]
-        return toks_all, kv_k, kv_v, win_k_in, win_v_in
+            return (toks_all, kv_k, kv_v, win_k, win_v,
+                    lp_chosen, lp_top, lp_ids)                    # [K, b]
+        return (toks_all, kv_k, kv_v, win_k_in, win_v_in,
+                lp_chosen, lp_top, lp_ids)
 
     def _execute_decode(self, batch: ScheduledBatch) -> List[List[int]]:
         cfg = self.config
@@ -412,6 +495,15 @@ class ModelRunner:
         bt = packed[NUM_SCALARS * b:].reshape(b, mb)
         f32 = sc.view(np.float32)
         u32 = sc.view(np.uint32)
+        has_penalties = any(
+            s.sampling.presence_penalty or s.sampling.frequency_penalty
+            for s in seqs
+        )
+        logprobs_k = max(
+            (logprobs_bucket(s.sampling.logprobs) for s in seqs
+             if s.sampling.logprobs is not None),
+            default=0,
+        )
         for i, s in enumerate(seqs):
             pos = s.num_computed_tokens
             sc[0, i] = s.all_token_ids[pos]
@@ -424,7 +516,20 @@ class ModelRunner:
             f32[5, i] = sp.temperature
             sc[6, i] = sp.top_k
             f32[7, i] = sp.top_p
+            f32[9, i] = sp.presence_penalty
+            f32[10, i] = sp.frequency_penalty
             bt[i, :len(s.block_ids)] = s.block_ids
+        if has_penalties:
+            vocab = self.model_config.vocab_size
+            counts = np.zeros((b, vocab), np.int32)
+            for i, s in enumerate(seqs):
+                if s.output_token_ids:
+                    np.add.at(
+                        counts[i],
+                        np.asarray(s.output_token_ids, np.int64) % vocab, 1,
+                    )
+        else:
+            counts = np.zeros((1, 1), np.int32)
 
         mc = self.model_config
         ids = tuple(s.request_id for s in seqs)
@@ -455,10 +560,13 @@ class ModelRunner:
             wk = jnp.zeros((1, 1, 1, 1, 1), self.dtype)
             wv = jnp.zeros((1, 1, 1, 1, 1), self.dtype)
 
-        toks_all, self.kv_k, self.kv_v, wk2, wv2 = self._decode(
-            self.params, jnp.asarray(packed), self.kv_k, self.kv_v, wk, wv,
-            b=b, mb=mb, num_steps=k, use_cached_window=use_cached,
-        )
+        toks_all, self.kv_k, self.kv_v, wk2, wv2, lp_c, lp_t, lp_i = \
+            self._decode(
+                self.params, jnp.asarray(packed), self.kv_k, self.kv_v,
+                wk, wv, jnp.asarray(counts),
+                b=b, mb=mb, num_steps=k, use_cached_window=use_cached,
+                has_penalties=has_penalties, logprobs_k=logprobs_k,
+            )
         if self.attn_impl != "paged":
             self._win_cache = {
                 "ids": ids, "b": b, "mb": mb,
@@ -469,20 +577,56 @@ class ModelRunner:
                 "win": (wk2, wv2),
             }
         out = np.asarray(toks_all)  # ONE [K, B] fetch per K*B tokens
-        return [
+        tokens = [
             [int(out[j, i]) for j in range(batch.decode_steps[i])]
             for i in range(len(seqs))
         ]
+        if not logprobs_k:
+            return tokens, None
+        return tokens, self._gather_logprobs(
+            seqs, batch.decode_steps, np.asarray(lp_c), np.asarray(lp_t),
+            np.asarray(lp_i),
+        )
+
+    @staticmethod
+    def _gather_logprobs(seqs, steps, lp_c, lp_t, lp_i):
+        """Per-seq aligned logprob entries from the dispatch arrays
+        ([K, b], [K, b, k], [K, b, k]): rows that asked for logprobs get
+        one (chosen_lp, [(token_id, lp), ...top-k-requested]) per accepted
+        token; others get None."""
+        out = []
+        for i, s in enumerate(seqs):
+            want = s.sampling.logprobs
+            if want is None:
+                out.append(None)
+                continue
+            entries = []
+            for j in range(steps[i]):
+                top = [
+                    (int(lp_i[j, i, r]), float(lp_t[j, i, r]))
+                    for r in range(min(want, lp_i.shape[-1]))
+                ]
+                entries.append((float(lp_c[j, i]), top))
+            out.append(entries)
+        return out
 
     # ----------------------------------------------------------------- prefill
-    def _prefill_impl(self, params, packed, kv_k, kv_v, *, b: int, t: int, mb: int,
-                      has_window: bool):
+    def _prefill_impl(self, params, packed, kv_k, kv_v, counts0, *, b: int,
+                      t: int, mb: int, has_window: bool,
+                      has_penalties: bool = False, logprobs_k: int = 0):
         """One (multi-sequence) prefill chunk dispatch.
 
-        packed: int32[b*(8+mb) + b*t]: 8 per-row scalars (chunk_start,
-        chunk_len, seed_base, gen0, temps, top_k, top_p, pad), the [b, mb]
-        block tables, then the [b, t] chunk token ids. Positions and the KV
-        write slots are derived on device.
+        packed: int32[b*(NUM_SCALARS+mb) + b*t]: per-row scalars
+        (chunk_start, chunk_len, seed_base, gen0, temps, top_k, top_p, pad,
+        adapter, presence, frequency), the [b, mb] block tables, then the
+        [b, t] chunk token ids. Positions and the KV write slots are
+        derived on device.
+
+        counts0/has_penalties/logprobs_k: see _decode_impl — they shape the
+        FINAL sampled token (non-final chunks never fetch it). Penalties
+        matter here only for preempted sequences re-prefilling with prior
+        output tokens; fresh prompts have zero counts (output-only
+        penalties, vLLM semantics).
         """
         cfg = self.config
         bs = cfg.block_size
@@ -496,6 +640,8 @@ class ModelRunner:
         top_k = scalars[5]
         top_p = jax.lax.bitcast_convert_type(scalars[6], jnp.float32)
         adapter_idx = scalars[8]
+        presence = jax.lax.bitcast_convert_type(scalars[9], jnp.float32)
+        frequency = jax.lax.bitcast_convert_type(scalars[10], jnp.float32)
         lora = (adapter_idx, self.lora_stacks) if self.lora_stacks else None
         block_tables = packed[NUM_SCALARS * b: NUM_SCALARS * b + b * mb].reshape(b, mb)
         token_ids = packed[NUM_SCALARS * b + b * mb:].reshape(b, t)
@@ -538,13 +684,25 @@ class ModelRunner:
         last_hidden = hidden[jnp.arange(b), logit_idx]            # [b, D]
         logits = self._logits_fn(params, mc, last_hidden)
         seeds = self._derive_seeds(seed_base, gen0, jnp.uint32(0))
-        next_tokens = sample_tokens(logits, temps, top_k, top_p, seeds)
+        if has_penalties:
+            from production_stack_tpu.engine.sampling import apply_penalties
+
+            eff = apply_penalties(logits, counts0, presence, frequency)
+        else:
+            eff = logits
+        next_tokens = sample_tokens(eff, temps, top_k, top_p, seeds)
+        if logprobs_k:
+            from production_stack_tpu.engine.sampling import compute_logprobs
+
+            lp = compute_logprobs(logits, next_tokens, logprobs_k)
+        else:
+            lp = (None, None, None)
 
         nl, hkv, dh = mc.num_layers, mc.num_kv_heads, mc.head_dim_
         flat_slots = slot_mapping.reshape(-1)                     # [b*t]
         kv_k = kv_k.at[:, :, flat_slots].set(k_new.reshape(nl, hkv, b * t, dh))
         kv_v = kv_v.at[:, :, flat_slots].set(v_new.reshape(nl, hkv, b * t, dh))
-        return next_tokens, kv_k, kv_v
+        return next_tokens, kv_k, kv_v, lp[0], lp[1], lp[2]
 
     def _execute_prefill(self, batch: ScheduledBatch) -> List[List[int]]:
         cfg = self.config
@@ -565,6 +723,24 @@ class ModelRunner:
                      max(1, cfg.max_blocks_per_seq))
         has_window = any(st > 0 for st in batch.chunk_starts)
 
+        finals = [
+            batch.chunk_starts[i] + batch.chunk_lens[i] >= seqs[i].num_tokens
+            for i in range(n)
+        ]
+        # Penalty/logprob variants only matter for the FINAL chunk's sampled
+        # token; non-final chunks stay on the default variant.
+        has_penalties = any(finals) and any(
+            s.sampling.presence_penalty or s.sampling.frequency_penalty
+            for s in seqs
+        )
+        logprobs_k = 0
+        if any(finals):
+            logprobs_k = max(
+                (logprobs_bucket(s.sampling.logprobs) for s in seqs
+                 if s.sampling.logprobs is not None),
+                default=0,
+            )
+
         packed = np.zeros((NUM_SCALARS * b + b * mb + b * t,), np.int32)
         sc = packed[: NUM_SCALARS * b].reshape(NUM_SCALARS, b)
         bt = packed[NUM_SCALARS * b: NUM_SCALARS * b + b * mb].reshape(b, mb)
@@ -582,27 +758,49 @@ class ModelRunner:
             f32[4, i] = sp.temperature
             sc[5, i] = sp.top_k
             f32[6, i] = sp.top_p
+            f32[9, i] = sp.presence_penalty
+            f32[10, i] = sp.frequency_penalty
             bt[i, :len(s.block_ids)] = s.block_ids
             toks[i, :ln] = s.all_token_ids[start:start + ln]
+        if has_penalties:
+            vocab = self.model_config.vocab_size
+            counts = np.zeros((b, vocab), np.int32)
+            for i, s in enumerate(seqs):
+                if s.output_token_ids:
+                    np.add.at(
+                        counts[i],
+                        np.asarray(s.output_token_ids, np.int64) % vocab, 1,
+                    )
+        else:
+            counts = np.zeros((1, 1), np.int32)
 
-        next_tokens, self.kv_k, self.kv_v = self._prefill(
+        next_tokens, self.kv_k, self.kv_v, lp_c, lp_t, lp_i = self._prefill(
             self.params, jnp.asarray(packed), self.kv_k, self.kv_v,
+            jnp.asarray(counts),
             b=b, t=t, mb=mb, has_window=has_window,
+            has_penalties=has_penalties, logprobs_k=logprobs_k,
         )
-        finals = [
-            batch.chunk_starts[i] + batch.chunk_lens[i] >= seqs[i].num_tokens
-            for i in range(n)
-        ]
         if not any(finals):
             # No row finished its prompt: skip the blocking fetch entirely.
-            return [[] for _ in range(n)]
+            return [[] for _ in range(n)], None
         out = np.asarray(next_tokens)
-        return [[int(out[i])] if finals[i] else [] for i in range(n)]
+        tokens = [[int(out[i])] if finals[i] else [] for i in range(n)]
+        if not logprobs_k:
+            return tokens, None
+        lp = self._gather_logprobs(
+            seqs, [1 if f else 0 for f in finals],
+            np.asarray(lp_c)[None], np.asarray(lp_t)[None],
+            np.asarray(lp_i)[None],
+        )
+        return tokens, lp
 
     # ---------------------------------------------------------------- execute
-    def execute(self, batch: ScheduledBatch, step_counter: int) -> List[List[int]]:
-        """Run one dispatch; returns per-sequence NEW token lists (empty for
-        a non-final prefill chunk, whose sampled token is never fetched)."""
+    def execute(self, batch: ScheduledBatch, step_counter: int):
+        """Run one dispatch; returns (token_lists, logprob_lists):
+        per-sequence NEW token lists (empty for a non-final prefill chunk,
+        whose sampled token is never fetched) and, when any row requested
+        logprobs, per-sequence aligned (chosen_lp, top-k) entry lists
+        (None otherwise — the default path fetches nothing extra)."""
         if batch.kind == "decode":
             return self._execute_decode(batch)
         return self._execute_prefill(batch)
@@ -805,6 +1003,7 @@ class ModelRunner:
                     self._decode.lower(
                         params_spec, spec(NUM_SCALARS * db + db * mb),
                         kv_spec, kv_spec, win_spec, win_spec,
+                        jax.ShapeDtypeStruct((1, 1), jnp.int32),
                         b=db, mb=mb, num_steps=dk,
                         use_cached_window=cached,
                     ).compile()
@@ -827,7 +1026,9 @@ class ModelRunner:
             for pb, t, has_window in sorted(prefill_shapes):
                 self._prefill.lower(
                     params_spec, spec(NUM_SCALARS * pb + pb * mb + pb * t),
-                    kv_spec, kv_spec, b=pb, t=t, mb=mb,
+                    kv_spec, kv_spec,
+                    jax.ShapeDtypeStruct((1, 1), jnp.int32),
+                    b=pb, t=t, mb=mb,
                     has_window=has_window,
                 ).compile()
             logger.info("Warmup compiled: decode(b=%d,mb=%d,K=%d) + prefill "

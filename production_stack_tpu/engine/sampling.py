@@ -65,6 +65,8 @@ class SamplingParams:
         logprobs = body.get("logprobs")
         if logprobs is True:  # chat-style bool + top_logprobs
             logprobs = int(get("top_logprobs", 0))
+        elif logprobs is False:  # chat-style explicit off
+            logprobs = None
         elif logprobs is not None:
             logprobs = int(logprobs)
         return SamplingParams(
@@ -80,6 +82,24 @@ class SamplingParams:
             presence_penalty=float(get("presence_penalty", 0.0)),
             frequency_penalty=float(get("frequency_penalty", 0.0)),
         )
+
+
+def apply_penalties(
+    logits: jax.Array,       # [B, V]
+    counts: jax.Array,       # [B, V] int — output-token occurrence counts
+    presence: jax.Array,     # [B]
+    frequency: jax.Array,    # [B]
+) -> jax.Array:
+    """OpenAI presence/frequency penalties over OUTPUT tokens (vLLM
+    semantics: prompt tokens are not penalized). Runs inside the jitted
+    dispatch; the decode scan threads ``counts`` through its carry so
+    mid-scan tokens are penalized too."""
+    cnt = counts.astype(logits.dtype)
+    return (
+        logits
+        - presence[:, None] * (cnt > 0).astype(logits.dtype)
+        - frequency[:, None] * cnt
+    )
 
 
 def _gumbel(seeds: jax.Array, shape) -> jax.Array:
@@ -139,7 +159,11 @@ def compute_logprobs(
     k: int,
 ) -> tuple:
     """(chosen_logprob [B], topk_logprobs [B, k], topk_ids [B, k]) for the
-    OpenAI ``logprobs`` response fields."""
+    OpenAI ``logprobs`` response fields.
+
+    Computed from the RAW logits — the model's distribution, not the
+    temperature/penalty-shaped sampling distribution (OpenAI semantics).
+    Called inside the jitted dispatches (runner logprob variants)."""
     logp = jax.nn.log_softmax(logits, axis=-1)
     chosen_lp = jnp.take_along_axis(logp, chosen[:, None], axis=-1)[:, 0]
     if k <= 0:

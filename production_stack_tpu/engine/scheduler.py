@@ -74,6 +74,9 @@ class Sequence:
 
     status: SequenceStatus = SequenceStatus.WAITING
     output_token_ids: List[int] = field(default_factory=list)
+    # Aligned with output_token_ids when sampling.logprobs is set: one
+    # (chosen_logprob, [(token_id, logprob), ...]) per accepted token.
+    output_logprobs: List = field(default_factory=list)
     block_ids: List[int] = field(default_factory=list)
     num_computed_tokens: int = 0       # tokens whose KV is in the device pool
     num_cached_tokens: int = 0         # prefix-cache hits (telemetry)
@@ -442,11 +445,13 @@ class Scheduler:
 
     # ------------------------------------------------------- post-step update
     def update_after_step(
-        self, batch: ScheduledBatch, token_lists: List[List[int]]
+        self, batch: ScheduledBatch, token_lists: List[List[int]],
+        logprob_lists=None,
     ) -> tuple:
         """Apply model outputs (a token list per sequence; empty for non-final
-        prefill chunks). Returns (sequences that produced NEW tokens,
-        number of tokens accepted)."""
+        prefill chunks; ``logprob_lists`` aligned per-token entries when any
+        row requested logprobs). Returns (sequences that produced NEW
+        tokens, number of tokens accepted)."""
         produced: List[Sequence] = []
         accepted = 0
         if batch.kind == "prefill":
@@ -458,7 +463,11 @@ class Scheduler:
                 self._register_full_blocks(seq)
                 if seq.num_computed_tokens >= seq.num_tokens:
                     # Prefill complete: the sampled token is the next token.
-                    self._append_token(seq, token_lists[idx][0])
+                    self._append_token(
+                        seq, token_lists[idx][0],
+                        logprob_lists[idx][0]
+                        if logprob_lists and logprob_lists[idx] else None,
+                    )
                     accepted += 1
                     produced.append(seq)
                     self.running.append(seq)
@@ -468,16 +477,19 @@ class Scheduler:
                     requeue.append(seq)
             self.waiting.extendleft(reversed(requeue))
         else:
-            for seq, toks in zip(batch.seqs, token_lists):
+            for i, (seq, toks) in enumerate(zip(batch.seqs, token_lists)):
                 if seq.status.is_finished:
                     continue  # aborted while the dispatch was in flight
                 took = False
-                for tok in toks:
+                lps = logprob_lists[i] if logprob_lists else None
+                for j, tok in enumerate(toks):
                     if seq.status.is_finished:
                         break  # EOS/max_tokens hit mid-scan; rest discarded
                     seq.num_computed_tokens += 1
                     self._register_full_blocks(seq)
-                    self._append_token(seq, tok)
+                    self._append_token(
+                        seq, tok, lps[j] if lps else None
+                    )
                     accepted += 1
                     took = True
                 if took:
@@ -487,10 +499,12 @@ class Scheduler:
                 self.running.remove(seq)
         return produced, accepted
 
-    def _append_token(self, seq: Sequence, token: int) -> None:
+    def _append_token(self, seq: Sequence, token: int, logprob=None) -> None:
         if seq.first_token_time is None:
             seq.first_token_time = time.monotonic()
         seq.output_token_ids.append(token)
+        if seq.sampling.logprobs is not None:
+            seq.output_logprobs.append(logprob)
         sp = seq.sampling
         n_out = len(seq.output_token_ids)
         if (
