@@ -4,7 +4,9 @@ from production_stack_tpu.utils.misc import (
     SingletonABCMeta,
     cdiv,
     pow2_bucket,
+    prefill_t_floor,
     round_up,
+    window_mb_bucket,
     parse_comma_separated,
     parse_static_model_names,
     parse_static_urls,
@@ -19,7 +21,9 @@ __all__ = [
     "SingletonABCMeta",
     "cdiv",
     "pow2_bucket",
+    "prefill_t_floor",
     "round_up",
+    "window_mb_bucket",
     "parse_comma_separated",
     "parse_static_model_names",
     "parse_static_urls",

@@ -182,11 +182,12 @@ def test_interactive_decode_uses_short_bursts():
                        num_decode_steps=32)
     bm = BlockPoolManager(64, cfg.block_size, True)
 
-    def running_seq(i):
+    def running_seq(i, n_out=1):
         seq = Sequence(request_id=f"r{i}", prompt_token_ids=[1, 2, 3],
                        sampling=SamplingParams(max_tokens=100))
         seq.status = SequenceStatus.RUNNING
-        seq.num_computed_tokens = 3
+        seq.output_token_ids = [7] * n_out
+        seq.num_computed_tokens = 3 + n_out
         seq.block_ids = list(bm.allocate_blocks(1))
         return seq
 
@@ -199,3 +200,13 @@ def test_interactive_decode_uses_short_bursts():
     sched2.running = [running_seq(i) for i in range(1, 9)]
     batch2 = sched2._schedule_decode()
     assert batch2.num_steps > 8
+
+    # A FRESH row (no output yet) caps the scan at the interactive tier so
+    # its first token is not delayed by a full-length fused dispatch (the
+    # round-4 p50-TTFT residual).
+    sched3 = Scheduler(cfg, bm)
+    sched3.running = [running_seq(i) for i in range(9, 16)] + [
+        running_seq(16, n_out=0)
+    ]
+    batch3 = sched3._schedule_decode()
+    assert batch3.num_steps <= 8

@@ -125,3 +125,25 @@ async def test_eos_mid_scan_finishes_row():
     assert final.finish_reason == "stop"
     # stop-token semantics: generation ends AT the first stop token
     assert final.token_ids == toks[:first_hit + 1]
+
+
+@pytest.mark.asyncio
+async def test_scan_loop_matches_while_loop():
+    """decode_loop='scan' (all-K lax.scan) and 'while' (early-exit
+    lax.while_loop) are semantically interchangeable: identical greedy and
+    seeded-sampled tokens, including rows whose budget ends mid-scan. The
+    knob exists for on-TPU A/B (EngineConfig.decode_loop)."""
+    results = {}
+    for loop in ("while", "scan"):
+        eng = _engine(K=16, decode_loop=loop)
+        await eng.start()
+        try:
+            greedy = await _collect(eng, "abc def", SamplingParams(
+                temperature=0.0, max_tokens=21, ignore_eos=True))
+            sampled = await _collect(eng, "xyz", SamplingParams(
+                temperature=0.8, seed=7, max_tokens=9, ignore_eos=True))
+        finally:
+            await eng.stop()
+        results[loop] = (greedy[-1].token_ids, sampled[-1].token_ids)
+    assert results["while"] == results["scan"]
+    assert len(results["while"][0]) == 21
