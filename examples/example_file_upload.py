@@ -1,0 +1,63 @@
+"""Upload a file to the router's files service.
+
+Mirrors reference src/examples/example_file_upload.py:1-38 (multipart POST
+to the router's /v1/files endpoint) using only the standard library.
+
+Usage:
+    python examples/example_file_upload.py --url http://localhost:30080 \
+        --path ./batch_input.jsonl
+"""
+
+import argparse
+import json
+import urllib.request
+import uuid
+
+
+def upload_file(server_url: str, file_path: str):
+    """Uploads a file to the production stack (router /v1/files)."""
+    boundary = uuid.uuid4().hex
+    with open(file_path, "rb") as f:
+        content = f.read()
+    parts = []
+    parts.append(
+        f"--{boundary}\r\n"
+        f'Content-Disposition: form-data; name="file"; '
+        f'filename="{file_path}"\r\n'
+        f"Content-Type: application/octet-stream\r\n\r\n".encode()
+        + content + b"\r\n"
+    )
+    parts.append(
+        f"--{boundary}\r\n"
+        f'Content-Disposition: form-data; name="purpose"\r\n\r\n'
+        f"batch\r\n--{boundary}--\r\n".encode()
+    )
+    req = urllib.request.Request(
+        f"{server_url}/v1/files",
+        data=b"".join(parts),
+        headers={
+            "Content-Type": f"multipart/form-data; boundary={boundary}",
+        },
+    )
+    try:
+        with urllib.request.urlopen(req) as resp:
+            print("File uploaded successfully:",
+                  json.dumps(json.loads(resp.read()), indent=2))
+    except urllib.error.HTTPError as e:
+        print("Failed to upload file:", e.read().decode())
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(
+        description="Uploads a file to the stack."
+    )
+    parser.add_argument("--path", type=str, required=True,
+                        help="Path to the file to upload.")
+    parser.add_argument("--url", type=str, default="http://localhost:30080",
+                        help="URL of the stack (router service).")
+    return parser.parse_args()
+
+
+if __name__ == "__main__":
+    args = parse_args()
+    upload_file(args.url, args.path)

@@ -1,0 +1,93 @@
+"""Tool-calling client against the router (or an engine directly).
+
+Mirrors reference src/examples/tool_calling_example.py:1-66: define a
+function schema, send it with tool_choice, execute the returned call. Uses
+only the standard library so it runs anywhere the stack does (the openai
+SDK works identically — point `base_url` at the router).
+
+Usage:
+    python examples/tool_calling_example.py --url http://localhost:30080 \
+        --model llama-1b [--force]
+"""
+
+import argparse
+import json
+import urllib.request
+
+
+def get_weather(location: str, unit: str):
+    """Mock weather function for demonstration."""
+    return f"Getting the weather for {location} in {unit}..."
+
+
+TOOLS = [
+    {
+        "type": "function",
+        "function": {
+            "name": "get_weather",
+            "description": "Get the current weather in a given location",
+            "parameters": {
+                "type": "object",
+                "properties": {
+                    "location": {
+                        "type": "string",
+                        "description":
+                            "City and state, e.g., 'San Francisco, CA'",
+                    },
+                    "unit": {
+                        "type": "string",
+                        "enum": ["celsius", "fahrenheit"],
+                        "description": "The unit of temperature to use",
+                    },
+                },
+                "required": ["location", "unit"],
+            },
+        },
+    }
+]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--url", default="http://localhost:30080",
+                    help="Router (or engine) base URL")
+    ap.add_argument("--model", default="llama-1b")
+    ap.add_argument("--force", action="store_true",
+                    help="Force the get_weather call via tool_choice")
+    args = ap.parse_args()
+
+    tool_choice = (
+        {"type": "function", "function": {"name": "get_weather"}}
+        if args.force else "auto"
+    )
+    body = {
+        "model": args.model,
+        "messages": [
+            {"role": "user",
+             "content": "What's the weather like in San Francisco?"},
+        ],
+        "tools": TOOLS,
+        "tool_choice": tool_choice,
+    }
+    req = urllib.request.Request(
+        f"{args.url}/v1/chat/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req) as resp:
+        out = json.loads(resp.read())
+
+    choice = out["choices"][0]
+    if choice["finish_reason"] != "tool_calls":
+        print("Model answered directly:", choice["message"]["content"])
+        return
+
+    call = choice["message"]["tool_calls"][0]["function"]
+    print(f"Function called: {call['name']}")
+    print(f"Arguments: {call['arguments']}")
+    result = get_weather(**json.loads(call["arguments"]))
+    print(f"Result: {result}")
+
+
+if __name__ == "__main__":
+    main()

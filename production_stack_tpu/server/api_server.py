@@ -251,15 +251,31 @@ class APIServer:
         err = self._check_unsupported(body, chat=True)
         if err is not None:
             return err
+        from production_stack_tpu.server.tool_calling import (
+            build_tool_context,
+            inject_tool_messages,
+            validate_tools,
+        )
+
+        terr = validate_tools(body)
+        if terr is not None:
+            return _error(400, terr)
+        tool_ctx = build_tool_context(body)
         try:
+            if tool_ctx is not None:
+                messages = inject_tool_messages(messages, tool_ctx)
             prompt = self.engine.tokenizer.apply_chat_template(
                 messages, add_generation_prompt=True
             )
-        except Exception as e:  # noqa: BLE001 — malformed messages
+        except Exception as e:  # noqa: BLE001 — malformed messages/history
             return _error(400, f"Could not apply chat template: {e}")
+        if tool_ctx is not None and tool_ctx.forced_prefix:
+            # Prompt-side forcing: seed the assistant turn with the call's
+            # JSON prefix (tool_calling.py module docstring).
+            prompt += tool_ctx.forced_prefix
         sampling = SamplingParams.from_request(body, default_max_tokens=256)
         return await self._generate_response(
-            request, body, [prompt], sampling, chat=True
+            request, body, [prompt], sampling, chat=True, tool_ctx=tool_ctx
         )
 
     async def completions(self, request: web.Request) -> web.StreamResponse:
@@ -271,20 +287,44 @@ class APIServer:
         if prompt is None:
             return _error(400, "'prompt' is required")
         # OpenAI multi-prompt: a list of strings serves every prompt and
-        # returns len(prompt) * n choices, prompt-major.
+        # returns len(prompt) * n choices, prompt-major. Token-id prompts
+        # (a list of ints, or a list of such lists) pass through to the
+        # engine AS IDS: decode->re-encode is not an identity roundtrip
+        # (byte-level merges, special tokens), so the model must see
+        # exactly the tokens the client specified (advisor r4 medium #2).
+        def _is_ids(p):
+            return isinstance(p, list) and p and all(
+                type(x) is int for x in p
+            )
+
         if isinstance(prompt, str):
             prompts = [prompt]
         elif isinstance(prompt, list) and prompt and all(
             isinstance(p, str) for p in prompt
         ):
             prompts = prompt
+        elif _is_ids(prompt):
+            prompts = [list(prompt)]
         elif isinstance(prompt, list) and prompt and all(
-            isinstance(p, int) for p in prompt
+            _is_ids(p) for p in prompt
         ):
-            prompts = [self.engine.tokenizer.decode(prompt)]
+            prompts = [list(p) for p in prompt]
         else:
             return _error(400, "'prompt' must be a non-empty string, list "
-                               "of strings, or list of token ids")
+                               "of strings, or list(s) of token ids")
+        # Bounds-check raw ids HERE: an out-of-vocab id would otherwise
+        # either clamp silently in the embedding gather (garbage with a
+        # 200) or overflow the int32 packed buffer mid-step — aborting
+        # co-batched requests.
+        vocab = self.engine.tokenizer.vocab_size
+        for p in prompts:
+            if isinstance(p, list) and any(
+                not 0 <= t < vocab for t in p
+            ):
+                return _error(
+                    400,
+                    f"prompt token ids must be in [0, {vocab})",
+                )
         model = body.get("model", self.model_name)
         if model not in self._served_models():
             return _error(404, f"Model '{model}' not found",
@@ -318,17 +358,21 @@ class APIServer:
             return _error(400, "'best_of' != n is not supported")
         lp = body.get("logprobs")
         if chat:
-            if lp not in (None, True, False):
+            # type check, not equality: 1 == True / 0 == False in Python,
+            # so an integer chat logprobs would silently take the int path
+            # (advisor r4 low #3).
+            if lp is not None and type(lp) is not bool:
                 return _error(
                     400, "chat 'logprobs' must be a boolean "
                          "(use 'top_logprobs' for the list width)")
             top = body.get("top_logprobs")
             if top is not None and (
-                not isinstance(top, int) or not 0 <= top <= 20
+                type(top) is bool or not isinstance(top, int)
+                or not 0 <= top <= 20
             ):
                 return _error(400, "'top_logprobs' must be in [0, 20]")
         elif lp is not None and (
-            not isinstance(lp, int) or not 0 <= lp <= 5
+            type(lp) is bool or not isinstance(lp, int) or not 0 <= lp <= 5
         ):
             return _error(400, "'logprobs' must be an integer in [0, 5]")
         return None
@@ -340,13 +384,14 @@ class APIServer:
     def _token_str(self, tid: int) -> str:
         return self.engine.tokenizer.decode([tid])
 
-    def _completion_logprobs(self, out) -> Optional[dict]:
-        """OpenAI completions-format logprobs block for a finished choice."""
-        if out.logprobs is None:
-            return None
+    def _completion_logprobs_slice(self, out, start: int, offset: int):
+        """OpenAI completions-format logprobs block for tokens from
+        ``start``; returns (block, next_text_offset) so streaming chunks
+        can continue the text_offset accounting across chunks."""
         tokens, token_lps, tops, offsets = [], [], [], []
-        offset = 0
-        for tid, entry in zip(out.token_ids, out.logprobs):
+        for tid, entry in zip(
+            out.token_ids[start:], (out.logprobs or [])[start:]
+        ):
             ts = self._token_str(tid)
             tokens.append(ts)
             offsets.append(offset)
@@ -363,7 +408,13 @@ class APIServer:
         return {
             "tokens": tokens, "token_logprobs": token_lps,
             "top_logprobs": tops, "text_offset": offsets,
-        }
+        }, offset
+
+    def _completion_logprobs(self, out) -> Optional[dict]:
+        """OpenAI completions-format logprobs block for a finished choice."""
+        if out.logprobs is None:
+            return None
+        return self._completion_logprobs_slice(out, 0, 0)[0]
 
     def _chat_logprobs_content(self, out, start: int = 0) -> list:
         """OpenAI chat-format logprobs content entries for tokens from
@@ -404,7 +455,7 @@ class APIServer:
 
     async def _generate_response(
         self, request: web.Request, body: dict, prompts: list,
-        sampling: SamplingParams, chat: bool,
+        sampling: SamplingParams, chat: bool, tool_ctx=None,
     ) -> web.StreamResponse:
         """Run len(prompts) * sampling.n generations and render them as
         OpenAI choices (prompt-major indexing), streaming or not. The
@@ -421,6 +472,15 @@ class APIServer:
             else "text_completion"
         )
         want_chat_lp = chat and sampling.logprobs is not None
+        want_lp = sampling.logprobs is not None
+        # A stop-string match can roll back already-emitted tokens (the
+        # fused scan overshoots by up to K-1; engine._process_output trims
+        # token_ids/logprobs). Logprob entries streamed for tokens later
+        # trimmed would be unretractable, so with stop strings set the
+        # entries ride the FINISH chunk, after any rollback (advisor r4
+        # low #5). Without stop strings tokens are never trimmed and
+        # entries stream incrementally.
+        defer_lp = want_lp and (bool(sampling.stop) or tool_ctx is not None)
         # (choice_index, prompt, child sampling, child request id)
         children = [
             (p_idx * n + c_idx, prompt,
@@ -435,7 +495,10 @@ class APIServer:
         # statically invalid (e.g. exceeds max_model_len).
         try:
             for prompt in prompts:
-                n_prompt = len(self.engine.tokenizer.encode(prompt))
+                n_prompt = (
+                    len(prompt) if isinstance(prompt, list)
+                    else len(self.engine.tokenizer.encode(prompt))
+                )
                 if n_prompt >= self.engine.config.max_model_len:
                     return _error(
                         400,
@@ -447,6 +510,14 @@ class APIServer:
 
         lora = self._lora_name(body)
 
+        def submit_kwargs(p):
+            # Token-id prompts go to the engine as ids (no decode->encode
+            # roundtrip — advisor r4 medium #2).
+            return (
+                {"prompt_token_ids": p} if isinstance(p, list)
+                else {"prompt": p}
+            )
+
         if stream:
             response = web.StreamResponse(
                 status=200,
@@ -457,12 +528,12 @@ class APIServer:
             await response.prepare(request)
             queue: asyncio.Queue = asyncio.Queue()
 
-            async def pump(idx: int, prompt: str, sp: SamplingParams,
+            async def pump(idx: int, prompt, sp: SamplingParams,
                            rid: str):
                 try:
                     async for out in self.engine.generate(
-                        prompt=prompt, sampling=sp, request_id=rid,
-                        lora_adapter=lora,
+                        **submit_kwargs(prompt), sampling=sp,
+                        request_id=rid, lora_adapter=lora,
                     ):
                         await queue.put((idx, out, None))
                 except Exception as e:  # noqa: BLE001 — relayed to writer
@@ -474,6 +545,16 @@ class APIServer:
             ]
             first_sent = [False] * num_choices
             lp_sent = [0] * num_choices
+            lp_offset = [0] * num_choices
+            tool_bufs = None
+            if tool_ctx is not None:
+                from production_stack_tpu.server.tool_calling import (
+                    StreamingToolBuffer,
+                )
+
+                tool_bufs = [
+                    StreamingToolBuffer(tool_ctx) for _ in range(num_choices)
+                ]
             finals: dict = {}
             try:
                 remaining = num_choices
@@ -485,17 +566,36 @@ class APIServer:
                     if out.finished:
                         remaining -= 1
                     if chat:
+                        # With tools active, content buffers until it
+                        # provably isn't a tool call (tool_calling.py).
+                        content = out.text_delta
+                        if tool_bufs is not None and content:
+                            content = tool_bufs[idx].feed(content)
                         delta = {}
                         if not first_sent[idx] and (
                             out.text_delta or not out.finished
                         ):
                             delta["role"] = "assistant"
                             first_sent[idx] = True
-                        if out.text_delta:
-                            delta["content"] = out.text_delta
+                        if content:
+                            delta["content"] = content
+                        finish_reason = out.finish_reason
+                        if tool_bufs is not None and out.finished:
+                            calls, residual = tool_bufs[idx].finish()
+                            if calls is not None:
+                                delta.pop("content", None)
+                                delta["tool_calls"] = [
+                                    {**c, "index": i}
+                                    for i, c in enumerate(calls)
+                                ]
+                                finish_reason = "tool_calls"
+                            elif residual:
+                                delta["content"] = (
+                                    delta.get("content", "") + residual
+                                )
                         choice = {
                             "index": idx, "delta": delta,
-                            "finish_reason": out.finish_reason,
+                            "finish_reason": finish_reason,
                         }
                         # Only account entries on chunks actually written
                         # (the detokenizer can hold back bytes, producing
@@ -503,7 +603,7 @@ class APIServer:
                         # entries must ride a later chunk, not vanish).
                         if want_chat_lp and out.logprobs is not None and (
                             out.text_delta or out.finished
-                        ):
+                        ) and (not defer_lp or out.finished):
                             new = self._chat_logprobs_content(
                                 out, lp_sent[idx]
                             )
@@ -515,7 +615,24 @@ class APIServer:
                             "index": idx, "text": out.text_delta,
                             "finish_reason": out.finish_reason,
                         }
-                    if out.text_delta or out.finished:
+                        # Streaming completions return per-chunk logprobs
+                        # blocks for the new tokens — previously computed
+                        # but silently dropped (advisor r4 medium #1).
+                        if want_lp and out.logprobs is not None and (
+                            out.text_delta or out.finished
+                        ) and (not defer_lp or out.finished):
+                            block, lp_offset[idx] = \
+                                self._completion_logprobs_slice(
+                                    out, lp_sent[idx], lp_offset[idx]
+                                )
+                            lp_sent[idx] = len(out.token_ids)
+                            if block["tokens"]:
+                                choice["logprobs"] = block
+                    write_now = (
+                        bool(delta) or out.finished if chat
+                        else bool(out.text_delta) or out.finished
+                    )
+                    if write_now:
                         await response.write(_sse({
                             "id": request_id, "object": object_name,
                             "created": created, "model": self.model_name,
@@ -560,7 +677,7 @@ class APIServer:
         async def collect(idx, prompt, sp, rid):
             text, final = "", None
             async for out in self.engine.generate(
-                prompt=prompt, sampling=sp, request_id=rid,
+                **submit_kwargs(prompt), sampling=sp, request_id=rid,
                 lora_adapter=lora,
             ):
                 text += out.text_delta
@@ -581,10 +698,29 @@ class APIServer:
             assert final is not None
             finals.append(final)
             if chat:
+                tool_calls = None
+                if tool_ctx is not None:
+                    from production_stack_tpu.server.tool_calling import (
+                        parse_tool_calls,
+                    )
+
+                    tool_calls = parse_tool_calls(
+                        tool_ctx.full_text(text),
+                        valid_names={
+                            t["function"]["name"] for t in tool_ctx.tools
+                        },
+                    )
+                if tool_calls is not None:
+                    message = {"role": "assistant", "content": None,
+                               "tool_calls": tool_calls}
+                    finish = "tool_calls"
+                else:
+                    message = {"role": "assistant", "content": text}
+                    finish = final.finish_reason
                 choice = {
                     "index": idx,
-                    "message": {"role": "assistant", "content": text},
-                    "finish_reason": final.finish_reason,
+                    "message": message,
+                    "finish_reason": finish,
                 }
                 if want_chat_lp:
                     choice["logprobs"] = {
@@ -642,6 +778,8 @@ def build_engine_from_args(args: argparse.Namespace) -> ServingEngine:
         data_parallel_size=args.data_parallel_size,
         **({"num_decode_steps": args.num_decode_steps}
            if args.num_decode_steps is not None else {}),
+        **({"decode_loop": args.decode_loop}
+           if args.decode_loop is not None else {}),
         attn_impl=args.attn_impl,
         enable_warmup=not args.no_warmup,
         lora_modules=_parse_lora_modules(args.lora_modules),
@@ -670,6 +808,9 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--sequence-parallel-size", type=int, default=1)
     p.add_argument("--data-parallel-size", type=int, default=1)
     p.add_argument("--num-decode-steps", type=int, default=None)
+    p.add_argument("--decode-loop", default=None, choices=["while", "scan"],
+                   help="fused-decode loop construct A/B "
+                        "(EngineConfig.decode_loop)")
     p.add_argument("--attn-impl", default="auto",
                    choices=["auto", "window", "paged", "xla", "pallas"])
     p.add_argument("--no-warmup", action="store_true",

@@ -253,3 +253,151 @@ async def test_unsupported_params_400(engine_cfg):
             assert resp.status == 400, extra
     finally:
         await client.close()
+
+
+async def test_completions_streaming_logprobs(engine_cfg):
+    """Streaming completions return per-chunk logprobs blocks whose union
+    covers every generated token (advisor r4 medium #1: they were computed
+    but silently dropped)."""
+    import json as _json
+
+    client = await _client(engine_cfg)
+    try:
+        resp = await client.post("/v1/completions", json={
+            "prompt": "hello world", "max_tokens": 5, "temperature": 0,
+            "ignore_eos": True, "logprobs": 2, "stream": True,
+        })
+        assert resp.status == 200
+        tokens, offsets = [], []
+        async for line in resp.content:
+            line = line.decode().strip()
+            if not line.startswith("data: ") or line == "data: [DONE]":
+                continue
+            chunk = _json.loads(line[len("data: "):])
+            for ch in chunk.get("choices", []):
+                lp = ch.get("logprobs")
+                if lp:
+                    tokens += lp["tokens"]
+                    offsets += lp["text_offset"]
+                    assert all(x <= 0.0 for x in lp["token_logprobs"])
+        assert len(tokens) == 5
+        # text_offset accounting continues across chunks
+        assert offsets == sorted(offsets) and offsets[0] == 0
+    finally:
+        await client.close()
+
+
+async def test_streaming_logprobs_defer_with_stop(engine_cfg):
+    """With stop strings set, logprob entries ride the finish chunk (after
+    any stop rollback) and exactly match the delivered token count
+    (advisor r4 low #5: entries streamed early can describe tokens a stop
+    match later trims)."""
+    import json as _json
+
+    client = await _client(engine_cfg)
+    try:
+        resp = await client.post("/v1/completions", json={
+            "prompt": "hello world", "max_tokens": 6, "temperature": 0,
+            "ignore_eos": True, "logprobs": 1, "stream": True,
+            "stop": ["ZZZ-never-matches"],
+        })
+        assert resp.status == 200
+        n_entries, finish = 0, None
+        async for line in resp.content:
+            line = line.decode().strip()
+            if not line.startswith("data: ") or line == "data: [DONE]":
+                continue
+            chunk = _json.loads(line[len("data: "):])
+            for ch in chunk.get("choices", []):
+                lp = ch.get("logprobs")
+                if lp:
+                    n_entries += len(lp["tokens"])
+                    # deferred: only the finishing chunk carries entries
+                    assert ch["finish_reason"] is not None
+                if ch.get("finish_reason"):
+                    finish = ch["finish_reason"]
+        assert finish == "length"
+        assert n_entries == 6
+    finally:
+        await client.close()
+
+
+async def test_token_id_prompt_passthrough(engine_cfg):
+    """Token-id prompts are served as the EXACT ids the client sent (no
+    decode->re-encode roundtrip — advisor r4 medium #2), and a list of
+    id-lists is the multi-prompt form."""
+    client = await _client(engine_cfg)
+    try:
+        text = "the quick brown fox"
+        # Recover the server's tokenization of `text` via usage accounting,
+        # then assert ids produce the identical greedy completion.
+        base = {"max_tokens": 4, "temperature": 0, "ignore_eos": True}
+        r1 = await client.post("/v1/completions",
+                               json={"prompt": text, **base})
+        assert r1.status == 200
+        j1 = await r1.json()
+
+        from production_stack_tpu.engine.tokenizer import get_tokenizer
+        from production_stack_tpu.models.config import resolve_model_config
+
+        tok = get_tokenizer("tiny-llama", resolve_model_config("tiny-llama"))
+        ids = tok.encode(text)
+        assert j1["usage"]["prompt_tokens"] == len(ids)
+        r2 = await client.post("/v1/completions",
+                               json={"prompt": ids, **base})
+        assert r2.status == 200
+        j2 = await r2.json()
+        assert j2["choices"][0]["text"] == j1["choices"][0]["text"]
+        assert j2["usage"]["prompt_tokens"] == len(ids)
+
+        # multi-prompt id-lists: one choice per list
+        r3 = await client.post("/v1/completions",
+                               json={"prompt": [ids, ids[:3]], **base})
+        assert r3.status == 200
+        j3 = await r3.json()
+        assert [c["index"] for c in j3["choices"]] == [0, 1]
+        assert j3["choices"][0]["text"] == j1["choices"][0]["text"]
+    finally:
+        await client.close()
+
+
+async def test_bool_int_logprobs_validation(engine_cfg):
+    """1 == True / 0 == False must not leak across the chat/completions
+    logprobs type split (advisor r4 low #3)."""
+    client = await _client(engine_cfg)
+    try:
+        # completions logprobs must be an int, not a bool
+        resp = await client.post("/v1/completions", json={
+            "prompt": "x", "max_tokens": 1, "logprobs": True,
+        })
+        assert resp.status == 400
+        # chat logprobs must be a bool, not an int (0 and 1 included)
+        for bad in (0, 1):
+            resp = await client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "x"}],
+                "max_tokens": 1, "logprobs": bad,
+            })
+            assert resp.status == 400, bad
+        # chat top_logprobs must be an int, not a bool
+        resp = await client.post("/v1/chat/completions", json={
+            "messages": [{"role": "user", "content": "x"}],
+            "max_tokens": 1, "logprobs": True, "top_logprobs": True,
+        })
+        assert resp.status == 400
+    finally:
+        await client.close()
+
+
+async def test_token_id_prompt_bounds_validated(engine_cfg):
+    """Out-of-vocab token ids 400 at parse time — they must never reach the
+    packed int32 buffer (overflow aborts co-batched requests) or clamp
+    silently in the embedding gather."""
+    client = await _client(engine_cfg)
+    try:
+        for bad in ([2**31], [-1], [0, 10**6]):
+            resp = await client.post("/v1/completions", json={
+                "prompt": bad, "max_tokens": 1,
+            })
+            assert resp.status == 400, bad
+    finally:
+        await client.close()

@@ -1,0 +1,376 @@
+"""Tool calling (`tools`/`tool_choice`) round-trip through the chat API.
+
+Reference contract: reference src/examples/tool_calling_example.py (client
+shape), tutorials/13-tool-enabled-installation.md (llama3_json parser
+convention). Model compliance depends on weights, so the HTTP round-trips
+here drive the real server with a canned engine stream — the injection,
+parsing, streaming delta, and finish_reason plumbing are what is under
+test; the parser/injection units are tested directly.
+"""
+
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.engine import RequestOutput, ServingEngine
+from production_stack_tpu.server.api_server import APIServer
+from production_stack_tpu.server.tool_calling import (
+    StreamingToolBuffer,
+    ToolContext,
+    build_tool_context,
+    inject_tool_messages,
+    parse_tool_calls,
+    validate_tools,
+)
+
+TOOLS = [{
+    "type": "function",
+    "function": {
+        "name": "get_weather",
+        "description": "Get the current weather in a given location",
+        "parameters": {
+            "type": "object",
+            "properties": {
+                "location": {"type": "string"},
+                "unit": {"type": "string",
+                         "enum": ["celsius", "fahrenheit"]},
+            },
+            "required": ["location", "unit"],
+        },
+    },
+}]
+
+CALL_JSON = ('{"name": "get_weather", "parameters": '
+             '{"location": "San Francisco, CA", "unit": "celsius"}}')
+
+
+# ------------------------------------------------------------------- units
+def test_parse_tool_calls_variants():
+    calls = parse_tool_calls(CALL_JSON)
+    assert calls and calls[0]["type"] == "function"
+    assert calls[0]["function"]["name"] == "get_weather"
+    assert json.loads(calls[0]["function"]["arguments"])["unit"] == "celsius"
+
+    # arguments key + surrounding prose + array form
+    assert parse_tool_calls(
+        'Sure! {"name": "f", "arguments": {"x": 1}} done'
+    )[0]["function"]["name"] == "f"
+    two = parse_tool_calls(
+        '[{"name": "a", "parameters": {}}, {"name": "b", "parameters": {}}]'
+    )
+    assert [c["function"]["name"] for c in two] == ["a", "b"]
+
+    # non-calls
+    assert parse_tool_calls("plain text answer") is None
+    assert parse_tool_calls('{"not_a_call": 1}') is None
+    assert parse_tool_calls('{"name": "x", "parameters": 3}') is None
+    assert parse_tool_calls(
+        '{"name": "evil", "parameters": {}}', valid_names={"get_weather"}
+    ) is None
+    # nested braces inside string args survive the span scan
+    nested = parse_tool_calls(
+        '{"name": "f", "parameters": {"code": "if x { y }"}}'
+    )
+    assert json.loads(nested[0]["function"]["arguments"])["code"] == \
+        "if x { y }"
+
+
+def test_validate_tools():
+    assert validate_tools({"tools": TOOLS}) is None
+    assert validate_tools({"tools": TOOLS, "tool_choice": "auto"}) is None
+    assert validate_tools({"tools": []}) is not None
+    assert validate_tools({"tools": [{"type": "function"}]}) is not None
+    assert validate_tools({"tool_choice": "auto"}) is not None
+    assert validate_tools({"tools": TOOLS, "tool_choice": "banana"}) \
+        is not None
+    assert validate_tools({
+        "tools": TOOLS,
+        "tool_choice": {"type": "function", "function": {"name": "nope"}},
+    }) is not None
+    assert validate_tools({
+        "tools": TOOLS,
+        "tool_choice": {"type": "function",
+                        "function": {"name": "get_weather"}},
+    }) is None
+
+
+def test_inject_tool_messages_and_history():
+    ctx = build_tool_context({"tools": TOOLS})
+    msgs = inject_tool_messages([
+        {"role": "system", "content": "Be helpful."},
+        {"role": "user", "content": "weather?"},
+        {"role": "assistant", "tool_calls": [{
+            "id": "call-1", "type": "function",
+            "function": {"name": "get_weather",
+                         "arguments": '{"location": "SF"}'},
+        }]},
+        {"role": "tool", "tool_call_id": "call-1", "name": "get_weather",
+         "content": "sunny"},
+    ], ctx)
+    assert "get_weather" in msgs[0]["content"]
+    assert "respond ONLY with a JSON object" in msgs[0]["content"]
+    # history renders to template-safe plain content
+    assert json.loads(msgs[2]["content"])["name"] == "get_weather"
+    assert "tool_calls" not in msgs[2]
+    assert "sunny" in msgs[3]["content"]
+
+    # no system message -> one is prepended; forced choice names the fn
+    ctx2 = build_tool_context({
+        "tools": TOOLS,
+        "tool_choice": {"type": "function",
+                        "function": {"name": "get_weather"}},
+    })
+    msgs2 = inject_tool_messages([{"role": "user", "content": "hi"}], ctx2)
+    assert msgs2[0]["role"] == "system"
+    assert 'MUST call the function "get_weather"' in msgs2[0]["content"]
+    assert ctx2.forced_prefix.startswith('{"name": "get_weather"')
+
+
+def test_streaming_buffer_passthrough_and_parse():
+    ctx = ToolContext(tools=TOOLS)
+    buf = StreamingToolBuffer(ctx)
+    # plain text flushes as soon as it can't be a call
+    assert buf.feed("Hel") == "Hel"
+    assert buf.feed("lo") == "lo"
+    assert buf.finish() == (None, "")
+
+    buf2 = StreamingToolBuffer(ctx)
+    for chunk in (CALL_JSON[:10], CALL_JSON[10:40], CALL_JSON[40:]):
+        assert buf2.feed(chunk) == ""
+    calls, residual = buf2.finish()
+    assert calls[0]["function"]["name"] == "get_weather" and residual == ""
+
+    # JSON-looking garbage falls back to residual content at finish
+    buf3 = StreamingToolBuffer(ctx)
+    assert buf3.feed("{broken json") == ""
+    calls, residual = buf3.finish()
+    assert calls is None and residual == "{broken json"
+
+
+# ------------------------------------------------------- HTTP round-trips
+def _canned_engine(cfg, text, chunks=3):
+    """Real ServingEngine whose generate() streams ``text`` in ``chunks``
+    pieces (records the submitted prompt for assertions)."""
+    engine = ServingEngine(cfg)
+    engine.seen_prompts = []
+
+    async def fake_generate(prompt=None, prompt_token_ids=None,
+                            sampling=None, request_id=None,
+                            lora_adapter=None):
+        engine.seen_prompts.append(prompt)
+        n = max(1, len(text) // chunks)
+        sent = 0
+        pieces = [text[i:i + n] for i in range(0, len(text), n)] or [""]
+        for i, piece in enumerate(pieces):
+            sent += len(piece)
+            yield RequestOutput(
+                request_id=request_id or "r",
+                text_delta=piece,
+                token_ids=list(range(i + 1)),
+                finished=(i == len(pieces) - 1),
+                finish_reason="stop" if i == len(pieces) - 1 else None,
+                num_prompt_tokens=7,
+                num_output_tokens=i + 1,
+            )
+
+    engine.generate = fake_generate
+    return engine
+
+
+@pytest.fixture()
+def cfg():
+    return EngineConfig(
+        model="tiny-llama", max_model_len=2048, block_size=4,
+        num_kv_blocks=64, max_num_seqs=4, max_num_batched_tokens=64,
+        dtype="float32",
+    )
+
+
+async def _client_for(engine):
+    client = TestClient(TestServer(APIServer(engine).build_app()))
+    await client.start_server()
+    return client
+
+
+async def test_chat_tool_call_round_trip(cfg):
+    engine = _canned_engine(cfg, CALL_JSON)
+    client = await _client_for(engine)
+    try:
+        resp = await client.post("/v1/chat/completions", json={
+            "messages": [
+                {"role": "user",
+                 "content": "What's the weather in San Francisco?"},
+            ],
+            "tools": TOOLS, "tool_choice": "auto", "max_tokens": 32,
+        })
+        assert resp.status == 200
+        choice = (await resp.json())["choices"][0]
+        assert choice["finish_reason"] == "tool_calls"
+        msg = choice["message"]
+        assert msg["content"] is None
+        call = msg["tool_calls"][0]
+        assert call["type"] == "function"
+        assert call["function"]["name"] == "get_weather"
+        args = json.loads(call["function"]["arguments"])
+        assert args == {"location": "San Francisco, CA", "unit": "celsius"}
+        # schemas were injected into the prompt the engine saw
+        assert "get_weather" in engine.seen_prompts[0]
+        assert "respond ONLY with a JSON object" in engine.seen_prompts[0]
+    finally:
+        await client.close()
+
+
+async def test_chat_forced_tool_choice_round_trip(cfg):
+    # The model only completes the seeded prefix: '...{"location": ...}}'
+    completion = '{"location": "Paris", "unit": "celsius"}}'
+    engine = _canned_engine(cfg, completion)
+    client = await _client_for(engine)
+    try:
+        resp = await client.post("/v1/chat/completions", json={
+            "messages": [{"role": "user", "content": "Weather in Paris?"}],
+            "tools": TOOLS,
+            "tool_choice": {"type": "function",
+                            "function": {"name": "get_weather"}},
+            "max_tokens": 32,
+        })
+        assert resp.status == 200
+        choice = (await resp.json())["choices"][0]
+        assert choice["finish_reason"] == "tool_calls"
+        call = choice["message"]["tool_calls"][0]
+        assert call["function"]["name"] == "get_weather"
+        assert json.loads(call["function"]["arguments"])["location"] == \
+            "Paris"
+        # the prompt was seeded with the forced JSON prefix
+        assert engine.seen_prompts[0].endswith(
+            '{"name": "get_weather", "parameters": '
+        )
+    finally:
+        await client.close()
+
+
+async def test_chat_tool_call_streaming(cfg):
+    engine = _canned_engine(cfg, CALL_JSON, chunks=5)
+    client = await _client_for(engine)
+    try:
+        resp = await client.post("/v1/chat/completions", json={
+            "messages": [{"role": "user", "content": "weather?"}],
+            "tools": TOOLS, "max_tokens": 32, "stream": True,
+        })
+        assert resp.status == 200
+        deltas, finish = [], None
+        async for line in resp.content:
+            line = line.decode().strip()
+            if not line.startswith("data: ") or line == "data: [DONE]":
+                continue
+            chunk = json.loads(line[len("data: "):])
+            for ch in chunk.get("choices", []):
+                deltas.append(ch["delta"])
+                if ch.get("finish_reason"):
+                    finish = ch["finish_reason"]
+        assert finish == "tool_calls"
+        # no content was streamed; one tool_calls delta carries the call
+        assert not any(d.get("content") for d in deltas)
+        calls = [d for d in deltas if d.get("tool_calls")]
+        assert len(calls) == 1
+        call = calls[0]["tool_calls"][0]
+        assert call["index"] == 0
+        assert call["function"]["name"] == "get_weather"
+        assert json.loads(call["function"]["arguments"])["unit"] == "celsius"
+    finally:
+        await client.close()
+
+
+async def test_chat_tools_attached_plain_answer_streams(cfg):
+    """tool_choice auto + a non-call answer: content must still stream (the
+    buffer flushes as soon as the text provably isn't JSON)."""
+    engine = _canned_engine(cfg, "The weather is sunny today.", chunks=4)
+    client = await _client_for(engine)
+    try:
+        resp = await client.post("/v1/chat/completions", json={
+            "messages": [{"role": "user", "content": "weather?"}],
+            "tools": TOOLS, "max_tokens": 32, "stream": True,
+        })
+        assert resp.status == 200
+        text, finish, n_content_chunks = "", None, 0
+        async for line in resp.content:
+            line = line.decode().strip()
+            if not line.startswith("data: ") or line == "data: [DONE]":
+                continue
+            chunk = json.loads(line[len("data: "):])
+            for ch in chunk.get("choices", []):
+                if ch["delta"].get("content"):
+                    text += ch["delta"]["content"]
+                    n_content_chunks += 1
+                if ch.get("finish_reason"):
+                    finish = ch["finish_reason"]
+        assert text == "The weather is sunny today."
+        assert n_content_chunks > 1  # streamed, not one buffered blob
+        assert finish == "stop"
+    finally:
+        await client.close()
+
+
+async def test_tool_validation_400s(cfg):
+    engine = _canned_engine(cfg, "x")
+    client = await _client_for(engine)
+    try:
+        base = {"messages": [{"role": "user", "content": "x"}],
+                "max_tokens": 4}
+        for extra in (
+            {"tools": []},
+            {"tools": [{"type": "banana"}]},
+            {"tool_choice": "auto"},                      # without tools
+            {"tools": TOOLS, "tool_choice": "sometimes"},
+            {"tools": TOOLS,
+             "tool_choice": {"type": "function",
+                             "function": {"name": "missing"}}},
+        ):
+            resp = await client.post("/v1/chat/completions",
+                                     json={**base, **extra})
+            assert resp.status == 400, extra
+        # tool_choice "none" with tools: served as plain chat, no injection
+        resp = await client.post("/v1/chat/completions", json={
+            **base, "tools": TOOLS, "tool_choice": "none",
+        })
+        assert resp.status == 200
+        assert "get_weather" not in engine.seen_prompts[-1]
+    finally:
+        await client.close()
+
+
+async def test_malformed_tool_history_400s(cfg):
+    """Untrusted tool history (missing function key, non-JSON arguments)
+    must 400, not 500."""
+    engine = _canned_engine(cfg, "x")
+    client = await _client_for(engine)
+    try:
+        base = {"tools": TOOLS, "max_tokens": 4}
+        for history in (
+            [{"role": "assistant", "tool_calls": [{}]}],
+            [{"role": "assistant", "tool_calls": [
+                {"function": {"name": "f", "arguments": "{not json"}},
+            ]}],
+        ):
+            resp = await client.post("/v1/chat/completions", json={
+                **base,
+                "messages": [{"role": "user", "content": "x"}] + history,
+            })
+            assert resp.status == 400, history
+        # dict-typed arguments (some clients send them unserialized) are OK
+        resp = await client.post("/v1/chat/completions", json={
+            **base,
+            "messages": [
+                {"role": "user", "content": "x"},
+                {"role": "assistant", "tool_calls": [{
+                    "id": "c1", "type": "function",
+                    "function": {"name": "get_weather",
+                                 "arguments": {"location": "SF"}},
+                }]},
+                {"role": "tool", "tool_call_id": "c1", "content": "sunny"},
+            ],
+        })
+        assert resp.status == 200
+    finally:
+        await client.close()
