@@ -64,3 +64,80 @@ class EngineMetricsCollector(Collector):
         yield gauge("pstpu:kv_offload_blocks",
                     "KV blocks resident in the host offload pool",
                     eng.offload_blocks_resident)
+
+
+# vLLM's bucket boundaries for the two request-latency histograms the
+# reference dashboard charts (reference observability/vllm-dashboard.json:
+# "Request TTFT distribution" sums vllm:time_to_first_token_seconds_bucket,
+# "Request latency distribution" sums vllm:e2e_request_latency_seconds_bucket).
+TTFT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.02, 0.04, 0.06, 0.08, 0.1, 0.25, 0.5, 0.75,
+    1.0, 2.5, 5.0, 7.5, 10.0,
+)
+E2E_BUCKETS = (
+    0.3, 0.5, 0.8, 1.0, 1.5, 2.0, 2.5, 5.0, 10.0, 15.0, 20.0, 30.0,
+    40.0, 50.0, 60.0,
+)
+
+
+class Histogram:
+    """Minimal cumulative Prometheus histogram (single label set).
+
+    Hand-rolled like the rest of the engine exposition so the hot path
+    (one observe per request event) is a bisect + three adds, with no
+    registry machinery."""
+
+    def __init__(self, buckets):
+        self.buckets = tuple(buckets)
+        self.counts = [0] * len(self.buckets)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        import bisect
+
+        i = bisect.bisect_left(self.buckets, value)
+        if i < len(self.counts):
+            self.counts[i] += 1
+        self.sum += value
+        self.count += 1
+
+    def render(self, name: str, help_text: str, label: str) -> list:
+        """Prometheus exposition lines; ``label`` like '{model_name="m"}'."""
+        inner = label[1:-1]  # strip braces to append le=
+        lines = [
+            f"# HELP {name} {help_text}",
+            f"# TYPE {name} histogram",
+        ]
+        cum = 0
+        for bound, c in zip(self.buckets, self.counts):
+            cum += c
+            sep = "," if inner else ""
+            lines.append(
+                f'{name}_bucket{{{inner}{sep}le="{bound}"}} {cum}'
+            )
+        sep = "," if inner else ""
+        lines.append(f'{name}_bucket{{{inner}{sep}le="+Inf"}} {self.count}')
+        lines.append(f"{name}_sum{label} {self.sum:.6f}")
+        lines.append(f"{name}_count{label} {self.count}")
+        return lines
+
+
+class RequestLatencyHistograms:
+    """TTFT + end-to-end latency histograms maintained by the engine."""
+
+    def __init__(self):
+        self.ttft = Histogram(TTFT_BUCKETS)
+        self.e2e = Histogram(E2E_BUCKETS)
+
+    def render(self, label: str) -> list:
+        return (
+            self.ttft.render(
+                "vllm:time_to_first_token_seconds",
+                "Time to first generated token", label,
+            )
+            + self.e2e.render(
+                "vllm:e2e_request_latency_seconds",
+                "End-to-end request latency", label,
+            )
+        )

@@ -7,7 +7,7 @@ reference dashboard charts but never emits (SURVEY.md §5 observability):
 here they are actually emitted.
 """
 
-from prometheus_client import Gauge
+from prometheus_client import Gauge, Histogram
 
 num_requests_running = Gauge(
     "vllm:num_requests_running",
@@ -52,6 +52,24 @@ gpu_prefix_cache_hit_rate = Gauge(
 router_queueing_delay_seconds = Gauge(
     "vllm:router_queueing_delay_seconds",
     "Router-side queueing delay (route decision to backend connect)", ["server"],
+)
+# Router-observed TTFT / end-to-end latency DISTRIBUTIONS (VERDICT r4 #5:
+# the gauges above export window averages only; percentile panels need
+# buckets). Engine pods additionally export the vLLM-named histograms
+# (vllm:time_to_first_token_seconds / vllm:e2e_request_latency_seconds)
+# that the reference dashboard's distribution panels query; these
+# router-side series measure the same requests INCLUDING router overhead.
+router_ttft_seconds = Histogram(
+    "vllm:router_ttft_seconds",
+    "Router-observed time to first streamed token", ["server"],
+    buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 0.75, 1.0, 2.5, 5.0,
+             7.5, 10.0, 20.0),
+)
+router_e2e_latency_seconds = Histogram(
+    "vllm:router_e2e_latency_seconds",
+    "Router-observed end-to-end request latency", ["server"],
+    buckets=(0.1, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 15.0, 30.0, 60.0,
+             120.0),
 )
 avg_prefill_length = Gauge(
     "vllm:avg_prefill_length", "Average prompt length per engine", ["server"],

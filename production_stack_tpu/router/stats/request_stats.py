@@ -110,6 +110,11 @@ class RequestStatsMonitor(metaclass=SingletonMeta):
         self._monitor(self.ttft_monitors, engine_url).update(
             timestamp, timestamp - start
         )
+        from production_stack_tpu.router import metrics
+
+        metrics.router_ttft_seconds.labels(server=engine_url).observe(
+            timestamp - start
+        )
 
     def on_request_token(self, engine_url: str, request_id: str, timestamp: float) -> None:
         """A subsequent streamed chunk arrived (inter-token latency)."""
@@ -134,6 +139,11 @@ class RequestStatsMonitor(metaclass=SingletonMeta):
             self._monitor(self.latency_monitors, engine_url).update(
                 timestamp, timestamp - start
             )
+            from production_stack_tpu.router import metrics
+
+            metrics.router_e2e_latency_seconds.labels(
+                server=engine_url
+            ).observe(timestamp - start)
         if tok is not None:
             self._monitor(self.decoding_length_monitors, engine_url).update(
                 timestamp, tok[1] + 1

@@ -43,4 +43,9 @@ def render_engine_metrics(engine: "ServingEngine", model_name: str) -> str:
         "# TYPE vllm:generation_tokens_total counter",
         f"vllm:generation_tokens_total{label} {s['generation_tokens_total']}",
     ]
+    # TTFT / e2e latency distributions (the reference dashboard's two
+    # distribution panels query these bucket series).
+    hists = getattr(engine, "histograms", None)
+    if hists is not None:
+        lines += hists.render(label)
     return "\n".join(lines) + "\n"

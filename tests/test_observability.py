@@ -27,11 +27,14 @@ def _exported_series():
         prefix_hits_total = 3
         prefix_queries_total = 7
 
+    from production_stack_tpu.engine.metrics import RequestLatencyHistograms
+
     class _FakeEngine:
         scheduler = _FakeSched()
         block_manager = _FakeBM()
         prompt_tokens_total = 10
         generation_tokens_total = 20
+        histograms = RequestLatencyHistograms()
 
         def stats(self):
             return {
@@ -83,6 +86,74 @@ def test_prom_adapter_rule_names_exported_series():
         series = _metric_names(rule["seriesQuery"])
         assert series <= exported
         assert rule["name"]["as"] == "vllm_num_requests_waiting"
+
+
+def test_latency_histograms_scrape():
+    """Engine /metrics exports the vLLM-named TTFT/e2e histogram buckets
+    the dashboard's distribution panels query, with sane cumulative counts
+    (VERDICT r4 #5); the router registry exports its own distributions."""
+    from production_stack_tpu.engine.metrics import RequestLatencyHistograms
+    from production_stack_tpu.server.metrics import render_engine_metrics
+
+    class _E:
+        histograms = RequestLatencyHistograms()
+
+        def stats(self):
+            return {
+                "num_requests_running": 0, "num_requests_waiting": 0,
+                "kv_cache_usage": 0.0, "prefix_cache_hits": 0,
+                "prefix_cache_queries": 0, "num_preemptions": 0,
+                "prompt_tokens_total": 0, "generation_tokens_total": 0,
+            }
+
+    e = _E()
+    for v in (0.03, 0.3, 3.0):
+        e.histograms.ttft.observe(v)
+        e.histograms.e2e.observe(v)
+    text = render_engine_metrics(e, "m")
+    assert 'vllm:time_to_first_token_seconds_bucket{model_name="m",le="+Inf"} 3' in text
+    assert 'vllm:e2e_request_latency_seconds_bucket{model_name="m",le="+Inf"} 3' in text
+    assert "vllm:time_to_first_token_seconds_count" in text
+    assert "vllm:e2e_request_latency_seconds_sum" in text
+    # cumulative monotonicity across buckets
+    counts = [
+        int(m.group(1)) for m in re.finditer(
+            r'vllm:time_to_first_token_seconds_bucket\{[^}]*\} (\d+)', text
+        )
+    ]
+    assert counts == sorted(counts) and counts[-1] == 3
+
+    # router-side distributions register + observe
+    from production_stack_tpu.router import metrics as rm
+
+    rm.router_ttft_seconds.labels(server="http://e1").observe(0.2)
+    rm.router_e2e_latency_seconds.labels(server="http://e1").observe(1.2)
+    from prometheus_client import generate_latest
+
+    scraped = generate_latest().decode()
+    assert "vllm:router_ttft_seconds_bucket" in scraped
+    assert "vllm:router_e2e_latency_seconds_bucket" in scraped
+
+
+def test_request_stats_monitor_feeds_histograms():
+    """The router's TTFT/complete hooks observe into the histogram series."""
+    from prometheus_client import generate_latest
+
+    from production_stack_tpu.router.stats.request_stats import (
+        RequestStatsMonitor,
+    )
+
+    mon = RequestStatsMonitor(sliding_window_size=10.0)
+    url = "http://hist-engine"
+    mon.on_new_request(url, "r1", 100.0)
+    mon.on_request_response(url, "r1", 100.4)
+    mon.on_request_complete(url, "r1", 101.5)
+    scraped = generate_latest().decode()
+    assert f'vllm:router_ttft_seconds_count{{server="{url}"}} 1.0' in scraped
+    assert (
+        f'vllm:router_e2e_latency_seconds_count{{server="{url}"}} 1.0'
+        in scraped
+    )
 
 
 def test_hpa_consumes_adapter_metric():
