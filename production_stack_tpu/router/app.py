@@ -268,9 +268,16 @@ def initialize_all(app: web.Application, args) -> None:
     gates = initialize_feature_gates(args.feature_gates)
 
     if gates.enabled(SEMANTIC_CACHE):
-        from production_stack_tpu.router.semantic_cache import SemanticCache
+        from production_stack_tpu.router.semantic_cache import (
+            SemanticCache,
+            create_embed_fn,
+        )
 
-        app["semantic_cache"] = SemanticCache()
+        app["semantic_cache"] = SemanticCache(
+            embed_fn=create_embed_fn(
+                getattr(args, "semantic_cache_embedder", "hashed-ngram")
+            ),
+        )
     if gates.enabled(PII_DETECTION):
         from production_stack_tpu.router.pii import (
             PIIAction,

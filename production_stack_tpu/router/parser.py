@@ -42,7 +42,13 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--pii-action", choices=["block", "redact"],
                    default="block",
                    help="what to do on PII detection (PIIDetection gate)")
-    p.add_argument("--pii-analyzer", default="regex")
+    p.add_argument("--pii-analyzer", default="regex",
+                   help="'regex' (dependency-free) or 'presidio' (needs "
+                        "presidio-analyzer + spacy model)")
+    p.add_argument("--semantic-cache-embedder", default="hashed-ngram",
+                   help="'hashed-ngram' (dependency-free) or "
+                        "'sentence-transformers[:model-name]' "
+                        "(SemanticCache gate)")
 
     p.add_argument("--enable-batch-api", action="store_true")
     p.add_argument("--file-storage-class", default="local_file")

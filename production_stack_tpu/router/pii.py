@@ -80,11 +80,72 @@ class RegexAnalyzer:
         return out
 
 
+# Our PII types <-> Presidio entity names (reference
+# src/vllm_router/experimental/pii/analyzers/presidio.py:45-56).
+_PII_TO_PRESIDIO = {
+    PIIType.EMAIL: "EMAIL_ADDRESS",
+    PIIType.PHONE: "PHONE_NUMBER",
+    PIIType.SSN: "US_SSN",
+    PIIType.CREDIT_CARD: "CREDIT_CARD",
+    PIIType.IP_ADDRESS: "IP_ADDRESS",
+    PIIType.API_KEY: "API_KEY",
+}
+_PRESIDIO_TO_PII = {v: k for k, v in _PII_TO_PRESIDIO.items()}
+
+
+class PresidioAnalyzer:
+    """NER-grade analyzer over Microsoft Presidio (optional dependency).
+
+    Same analyze() interface as RegexAnalyzer, so it drops into PIIChecker
+    via ``--pii-analyzer presidio`` (reference
+    experimental/pii/analyzers/presidio.py:57-172). Import/initialize
+    errors raise at CONSTRUCTION time with an actionable message — the
+    reference defers to first use, which turns a missing spacy model into
+    a per-request 500.
+    """
+
+    def __init__(self, types: Optional[List[PIIType]] = None,
+                 score_threshold: float = 0.5, engine=None):
+        self.types = types or list(PIIType)
+        self.score_threshold = score_threshold
+        if engine is not None:
+            self._engine = engine  # injected (tests, custom NLP config)
+            return
+        try:
+            from presidio_analyzer import AnalyzerEngine
+        except ImportError as e:
+            raise RuntimeError(
+                "PII analyzer 'presidio' needs presidio-analyzer (pip "
+                "install presidio-analyzer && python -m spacy download "
+                "en_core_web_sm); use --pii-analyzer regex for the "
+                "dependency-free tier"
+            ) from e
+        self._engine = AnalyzerEngine()
+
+    def analyze(self, text: str) -> List[PIIMatch]:
+        entities = [
+            _PII_TO_PRESIDIO[t] for t in self.types if t in _PII_TO_PRESIDIO
+        ]
+        results = self._engine.analyze(
+            text=text, language="en", entities=entities,
+            score_threshold=self.score_threshold,
+        )
+        out = []
+        for r in results:
+            t = _PRESIDIO_TO_PII.get(r.entity_type)
+            if t is None:
+                continue
+            out.append(PIIMatch(t, r.start, r.end, text[r.start:r.end]))
+        return out
+
+
 def create_analyzer(kind: str = "regex", **kwargs):
     if kind == "regex":
         return RegexAnalyzer(**kwargs)
+    if kind == "presidio":
+        return PresidioAnalyzer(**kwargs)
     raise ValueError(
-        f"Unknown PII analyzer {kind!r} (this build ships 'regex')"
+        f"Unknown PII analyzer {kind!r} (available: regex, presidio)"
     )
 
 

@@ -59,6 +59,58 @@ def hashed_ngram_embed(text: str, dim: int = 512) -> np.ndarray:
     return vec / n if n > 0 else vec
 
 
+def sentence_transformer_embed_fn(
+    model_name: str = "all-MiniLM-L6-v2", model=None,
+) -> Callable[[str], np.ndarray]:
+    """Real-model ``embed_fn`` over sentence-transformers (optional dep).
+
+    Matches the reference semantic cache's embedder (reference
+    experimental/semantic_cache/semantic_cache.py:16-313 uses
+    SentenceTransformer + FAISS; the numpy inner-product index here serves
+    the same L2-normalized vectors). Pass a preloaded ``model`` (anything
+    with ``encode(text) -> vector``) to skip the checkpoint load — tests
+    and embedded deployments inject one; otherwise the named checkpoint is
+    loaded at construction so a missing dependency fails fast, not on the
+    first cached request.
+
+    Select via ``--semantic-cache-embedder sentence-transformers:<name>``.
+    """
+    if model is None:
+        try:
+            from sentence_transformers import SentenceTransformer
+        except ImportError as e:
+            raise RuntimeError(
+                "semantic-cache embedder 'sentence-transformers' needs the "
+                "sentence-transformers package; omit the flag for the "
+                "dependency-free hashed-ngram embedder"
+            ) from e
+        model = SentenceTransformer(model_name)
+
+    def embed(text: str) -> np.ndarray:
+        vec = np.asarray(model.encode(text), dtype=np.float32).reshape(-1)
+        n = np.linalg.norm(vec)
+        return vec / n if n > 0 else vec
+
+    return embed
+
+
+def create_embed_fn(spec: str) -> Callable[[str], np.ndarray]:
+    """Embedder factory from a CLI spec: 'hashed-ngram' (default) or
+    'sentence-transformers[:model-name]'."""
+    if spec in ("", "hashed-ngram", None):
+        return hashed_ngram_embed
+    if spec == "sentence-transformers":
+        return sentence_transformer_embed_fn()
+    if spec.startswith("sentence-transformers:"):
+        return sentence_transformer_embed_fn(
+            spec.split(":", 1)[1]
+        )
+    raise ValueError(
+        f"Unknown semantic-cache embedder {spec!r} (available: "
+        f"hashed-ngram, sentence-transformers[:model-name])"
+    )
+
+
 class SemanticCache:
     def __init__(
         self,

@@ -1,0 +1,162 @@
+"""Optional-dependency tiers: Presidio PII analyzer + sentence-transformers
+semantic-cache embedder (VERDICT r4 #7).
+
+Both adapters run here through INJECTED engines/models (the mapping and
+wiring logic is dependency-free); the real-dependency paths run when the
+packages are installed and skip with a reason when not — mirroring the
+reference's optional tiers (reference
+src/vllm_router/experimental/pii/analyzers/presidio.py,
+experimental/semantic_cache/semantic_cache.py).
+"""
+
+import numpy as np
+import pytest
+
+from production_stack_tpu.router.pii import (
+    PIIAction,
+    PIIChecker,
+    PIIType,
+    PresidioAnalyzer,
+    create_analyzer,
+)
+from production_stack_tpu.router.semantic_cache import (
+    SemanticCache,
+    create_embed_fn,
+    sentence_transformer_embed_fn,
+)
+
+
+class _FakePresidioResult:
+    def __init__(self, entity_type, start, end, score=0.9):
+        self.entity_type = entity_type
+        self.start = start
+        self.end = end
+        self.score = score
+
+
+class _FakePresidioEngine:
+    """Duck-typed presidio AnalyzerEngine returning canned results."""
+
+    def __init__(self, results):
+        self.results = results
+        self.calls = []
+
+    def analyze(self, text, language, entities, score_threshold):
+        self.calls.append((text, language, tuple(entities), score_threshold))
+        return self.results
+
+
+def test_presidio_analyzer_maps_entities():
+    text = "mail me at a@b.com or +1 555 123 4567"
+    engine = _FakePresidioEngine([
+        _FakePresidioResult("EMAIL_ADDRESS", 11, 18),
+        _FakePresidioResult("PHONE_NUMBER", 22, 37),
+        _FakePresidioResult("UNMAPPED_TYPE", 0, 4),   # dropped
+    ])
+    an = PresidioAnalyzer(engine=engine)
+    matches = an.analyze(text)
+    assert [m.pii_type for m in matches] == [PIIType.EMAIL, PIIType.PHONE]
+    assert matches[0].text == text[11:18]
+    # the engine saw our full entity allowlist and threshold
+    _, lang, entities, thr = engine.calls[0]
+    assert lang == "en" and "US_SSN" in entities and thr == 0.5
+
+
+async def test_presidio_analyzer_in_checker_redacts():
+    from aiohttp.test_utils import make_mocked_request
+    import json
+
+    text = "ssn is 078-05-1120 ok"
+    engine = _FakePresidioEngine([_FakePresidioResult("US_SSN", 7, 18)])
+    checker = PIIChecker(
+        action=PIIAction.REDACT, analyzer=PresidioAnalyzer(engine=engine)
+    )
+    body = json.dumps({"prompt": text}).encode()
+    req = make_mocked_request("POST", "/v1/completions", payload=None)
+    req.read = lambda: _async_return(body)
+    resp = await checker.check(req)
+    assert resp is None
+    redacted = json.loads(req["pii_redacted_body"])
+    assert "078-05-1120" not in redacted["prompt"]
+    assert "[REDACTED:ssn]" in redacted["prompt"]
+
+
+async def _async_return(v):
+    return v
+
+
+def test_presidio_without_dep_errors_actionably():
+    pytest.importorskip
+    try:
+        import presidio_analyzer  # noqa: F401
+        pytest.skip("presidio installed; the error path can't trigger")
+    except ImportError:
+        pass
+    with pytest.raises(RuntimeError, match="presidio-analyzer"):
+        create_analyzer("presidio")
+
+
+def test_presidio_real_engine_detects_email():
+    pytest.importorskip("presidio_analyzer")
+    an = create_analyzer("presidio")
+    matches = an.analyze("contact john.doe@example.com please")
+    assert any(m.pii_type == PIIType.EMAIL for m in matches)
+
+
+class _FakeSentenceTransformer:
+    """Duck-typed SentenceTransformer: deterministic char-histogram."""
+
+    def encode(self, text):
+        vec = np.zeros(64, dtype=np.float32)
+        for ch in text.lower():
+            vec[ord(ch) % 64] += 1.0
+        return vec
+
+
+def test_sentence_transformer_embed_fn_injected_model():
+    fn = sentence_transformer_embed_fn(model=_FakeSentenceTransformer())
+    v = fn("hello world")
+    assert v.shape == (64,)
+    assert abs(np.linalg.norm(v) - 1.0) < 1e-5
+    # near-duplicate texts are closer than unrelated ones
+    sim_close = float(v @ fn("hello world!"))
+    sim_far = float(v @ fn("zzzz qqqq xxxx"))
+    assert sim_close > sim_far
+
+
+def test_semantic_cache_with_real_model_interface(tmp_path):
+    cache = SemanticCache(
+        persist_path=str(tmp_path / "cache.pkl"),
+        embed_fn=sentence_transformer_embed_fn(
+            model=_FakeSentenceTransformer()
+        ),
+    )
+    body = {"model": "m", "messages": [
+        {"role": "user", "content": "what is the capital of france"},
+    ]}
+    cache.store_response(body, b'{"answer": "paris"}')
+    vec = cache.embed_fn(cache._request_text(body))
+    hit = cache._search(vec, "m")
+    assert hit is not None and hit["response"] == {"answer": "paris"}
+
+
+def test_create_embed_fn_specs():
+    from production_stack_tpu.router.semantic_cache import hashed_ngram_embed
+
+    assert create_embed_fn("hashed-ngram") is hashed_ngram_embed
+    assert create_embed_fn("") is hashed_ngram_embed
+    with pytest.raises(ValueError):
+        create_embed_fn("banana")
+
+
+def test_sentence_transformers_real_model():
+    st = pytest.importorskip("sentence_transformers")
+    import os
+
+    if not os.environ.get("PSTPU_TEST_ST_MODEL"):
+        pytest.skip("no local sentence-transformers checkpoint configured "
+                    "(set PSTPU_TEST_ST_MODEL=<path>); zero-egress image "
+                    "cannot download one")
+    fn = sentence_transformer_embed_fn(os.environ["PSTPU_TEST_ST_MODEL"])
+    v = fn("hello")
+    assert abs(np.linalg.norm(v) - 1.0) < 1e-4
