@@ -1,0 +1,82 @@
+"""ShareGPT preprocessing for the multi-round-qa workload.
+
+Mirrors reference benchmarks/multi-round-qa/data_preprocessing.py: annotate
+each ShareGPT conversation with round counts and token statistics, then
+write the processed list for ``multi_round_qa --sharegpt``. Token counts
+use a local HF tokenizer when one is available (``--tokenizer PATH``);
+otherwise a words*1.3 estimate — this image has no network egress, so the
+reference's on-demand Mistral tokenizer download is not an option.
+
+Usage:
+    python3 benchmarks/data_preprocessing.py \
+        --input ShareGPT_V3_unfiltered_cleaned_split.json \
+        --output sharegpt_processed.json [--parse 0.1] [--tokenizer PATH]
+"""
+
+import argparse
+import json
+
+
+def make_token_counter(tokenizer_path=None):
+    if tokenizer_path:
+        from transformers import AutoTokenizer
+
+        tok = AutoTokenizer.from_pretrained(
+            tokenizer_path, local_files_only=True
+        )
+        return lambda text: len(tok.tokenize(text))
+    return lambda text: max(1, int(len(text.split()) * 1.3))
+
+
+def preprocess(data, count_tokens):
+    """Annotate conversations in place (reference logic: num_round plus
+    human/gpt token statistics per conversation)."""
+    out = []
+    for d in data:
+        convs = d.get("conversations", [])
+        d["num_round"] = len(convs)
+        human_tokens, gpt_tokens = [], []
+        for conv in convs:
+            if conv.get("from") == "human":
+                human_tokens.append(count_tokens(conv.get("value", "")))
+            elif conv.get("from") == "gpt":
+                n = count_tokens(conv.get("value", ""))
+                conv["num_tokens"] = n
+                gpt_tokens.append(n)
+        d["average_human_token"] = (
+            sum(human_tokens) / len(human_tokens) if human_tokens else 0
+        )
+        d["max_human_token"] = max(human_tokens, default=0)
+        d["average_gpt_token"] = (
+            sum(gpt_tokens) / len(gpt_tokens) if gpt_tokens else 0
+        )
+        d["max_gpt_token"] = max(gpt_tokens, default=0)
+        if human_tokens:  # conversations with no human turn can't drive QA
+            out.append(d)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--input",
+                    default="ShareGPT_V3_unfiltered_cleaned_split.json")
+    ap.add_argument("--output", default="sharegpt_processed.json")
+    ap.add_argument("--parse", type=float, default=1.0,
+                    help="fraction of the dataset to process (0..1)")
+    ap.add_argument("--tokenizer", default=None,
+                    help="local HF tokenizer path for exact token counts "
+                         "(default: word-count estimate; no downloads)")
+    args = ap.parse_args()
+
+    with open(args.input, encoding="utf-8") as f:
+        data = json.load(f)
+    print(f"Number of IDs: {len(data)}")
+    data = data[: int(len(data) * args.parse)]
+    processed = preprocess(data, make_token_counter(args.tokenizer))
+    with open(args.output, "w", encoding="utf-8") as f:
+        json.dump(processed, f)
+    print(f"wrote {len(processed)} conversations to {args.output}")
+
+
+if __name__ == "__main__":
+    main()

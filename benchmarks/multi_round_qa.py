@@ -53,6 +53,16 @@ class WorkloadConfig:
     session_header: str = "x-user-id"
     api_key: Optional[str] = None
     timeout_s: float = 300.0
+    # QPS-paced session ramp (reference run.sh sweep contract,
+    # reference benchmarks/multi-round-qa/run.sh:43-82): a new user session
+    # starts every 1/qps seconds. Overrides gap_between_users_s when set.
+    qps: Optional[float] = None
+    # Wall-clock bound (reference --time): sessions start no NEW round
+    # after this many seconds; in-flight rounds complete and are recorded.
+    time_limit_s: Optional[float] = None
+    # Pre-processed ShareGPT conversations (data_preprocessing.py output):
+    # questions come from real human turns instead of synthetic text.
+    sharegpt: Optional[list] = None
     # Distinguishes question text across workload invocations: a warmup pass
     # must use a different tag than the timed pass so only the
     # (intentionally) shared system prefix is warm in the engine's prefix
@@ -85,12 +95,24 @@ class UserSession:
         self.messages = [{"role": "system", "content": system_prompt}]
         self.records: List[RequestRecord] = []
 
-    async def _one_round(self, http: aiohttp.ClientSession, rnd: int) -> None:
+    def _question(self, rnd: int) -> str:
         cfg = self.cfg
-        question = (
+        if cfg.sharegpt:
+            conv = cfg.sharegpt[self.user_id % len(cfg.sharegpt)]
+            humans = [
+                t["value"] for t in conv.get("conversations", [])
+                if t.get("from") == "human"
+            ]
+            if rnd < len(humans):
+                return f"user {self.user_id} {cfg.tag} {rnd}: {humans[rnd]}"
+        return (
             f"user {self.user_id} {cfg.tag} {rnd}: "
             + synth_text(cfg.question_words, seed=self.user_id * 31 + rnd)
         )
+
+    async def _one_round(self, http: aiohttp.ClientSession, rnd: int) -> None:
+        cfg = self.cfg
+        question = self._question(rnd)
         self.messages.append({"role": "user", "content": question})
         headers = {cfg.session_header: f"user-{self.user_id}"}
         if cfg.api_key:
@@ -139,10 +161,13 @@ class UserSession:
             generation_tokens=generation_tokens,
         ))
 
-    async def run(self, http: aiohttp.ClientSession, start_delay: float):
+    async def run(self, http: aiohttp.ClientSession, start_delay: float,
+                  deadline: Optional[float] = None):
         if start_delay > 0:
             await asyncio.sleep(start_delay)
         for rnd in range(self.cfg.num_rounds):
+            if deadline is not None and time.monotonic() >= deadline:
+                break
             await self._one_round(http, rnd)
 
 
@@ -154,14 +179,34 @@ async def run_workload(cfg: WorkloadConfig) -> List[RequestRecord]:
     sessions = [
         UserSession(cfg, u, system_prompt) for u in range(cfg.num_users)
     ]
+    gap = (1.0 / cfg.qps) if cfg.qps else cfg.gap_between_users_s
     timeout = aiohttp.ClientTimeout(total=cfg.timeout_s)
     conn = aiohttp.TCPConnector(limit=0)
+    deadline = (
+        time.monotonic() + cfg.time_limit_s
+        if cfg.time_limit_s is not None else None
+    )
     async with aiohttp.ClientSession(timeout=timeout, connector=conn) as http:
         await asyncio.gather(*[
-            s.run(http, u * cfg.gap_between_users_s)
-            for u, s in enumerate(sessions)
+            s.run(http, u * gap, deadline) for u, s in enumerate(sessions)
         ])
     return [r for s in sessions for r in s.records]
+
+
+def write_csv(records: List[RequestRecord], path: str) -> None:
+    """Per-request CSV, column-compatible with the reference's plot.py
+    (reads the 'ttft' column of {key}_output_{qps}.csv)."""
+    import csv
+
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["user", "round", "launch_time", "ttft", "finish_time",
+                    "prompt_tokens", "generation_tokens", "generation_time"])
+        for r in records:
+            w.writerow([r.user, r.round, f"{r.launch_time:.6f}",
+                        f"{r.ttft:.6f}", f"{r.finish_time:.6f}",
+                        r.prompt_tokens, r.generation_tokens,
+                        f"{r.generation_time:.6f}"])
 
 
 def summarize(records: List[RequestRecord]) -> dict:
@@ -206,7 +251,24 @@ def main() -> int:
                     help="Full extra passes run (and discarded) before the "
                          "timed workload, so device compile happens outside "
                          "the measurement")
+    ap.add_argument("--qps", type=float, default=None,
+                    help="session-launch rate (reference run.sh sweep "
+                         "contract); overrides --gap-between-users")
+    ap.add_argument("--time", type=float, default=None, dest="time_limit",
+                    help="wall-clock bound: no new rounds start after this "
+                         "many seconds (reference --time)")
+    ap.add_argument("--output", default=None,
+                    help="write a per-request CSV (plot.py-compatible "
+                         "'ttft' column)")
+    ap.add_argument("--sharegpt", default=None,
+                    help="pre-processed ShareGPT json "
+                         "(benchmarks/data_preprocessing.py output): "
+                         "questions come from real conversations")
     args = ap.parse_args()
+    sharegpt = None
+    if args.sharegpt:
+        with open(args.sharegpt) as f:
+            sharegpt = json.load(f)
     cfg = WorkloadConfig(
         base_url=args.base_url, model=args.model, num_users=args.num_users,
         num_rounds=args.num_rounds,
@@ -214,12 +276,16 @@ def main() -> int:
         question_words=args.question_words, answer_tokens=args.answer_tokens,
         gap_between_users_s=args.gap_between_users,
         session_header=args.session_header, api_key=args.api_key,
+        qps=args.qps, time_limit_s=args.time_limit, sharegpt=sharegpt,
     )
     if args.warmup_rounds > 0:
         warm_cfg = WorkloadConfig(**{**cfg.__dict__,
-                                     "num_rounds": args.warmup_rounds})
+                                     "num_rounds": args.warmup_rounds,
+                                     "tag": "warmup"})
         asyncio.run(run_workload(warm_cfg))
     records = asyncio.run(run_workload(cfg))
+    if args.output:
+        write_csv(records, args.output)
     print(json.dumps(summarize(records), indent=2))
     return 0
 

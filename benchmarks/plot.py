@@ -1,0 +1,81 @@
+"""TTFT-vs-QPS sweep curve from run.sh output CSVs.
+
+Mirrors reference benchmarks/multi-round-qa/plot.py: for each key
+(deployment variant) read {key}_output_{qps}.csv, average the 'ttft'
+column, and draw one line per key. Keys are discovered from the files
+present, so any set of variants plots (the reference hard-codes
+stack/aibrix/naive).
+
+Usage:
+    python3 benchmarks/plot.py [--dir .] [--out multi-round.png]
+"""
+
+import argparse
+import glob
+import os
+import re
+
+import pandas as pd
+
+QPS_RANGE = [0.1, 0.5, 0.9, 1.3, 1.7, 2.1, 2.5, 2.9, 3.3, 3.7, 4.1]
+_STYLE = {
+    "stack": {"marker": "x", "color": "blue"},
+    "aibrix": {"marker": "o", "color": "red"},
+    "naive": {"marker": "s", "color": "green"},
+}
+
+
+def collect(directory: str):
+    """{key: (qps_list, avg_ttft_list)} from {key}_output_{qps}.csv files."""
+    keys = sorted({
+        m.group(1)
+        for f in glob.glob(os.path.join(directory, "*_output_*.csv"))
+        if (m := re.match(r"(.+)_output_[\d.]+\.csv$", os.path.basename(f)))
+    })
+    out = {}
+    for key in keys:
+        qpses, ttfts = [], []
+        for qps in QPS_RANGE:
+            f = os.path.join(directory, f"{key}_output_{round(qps, 1)}.csv")
+            if not os.path.exists(f):
+                continue
+            data = pd.read_csv(f)["ttft"].tolist()
+            if not data:
+                continue
+            qpses.append(round(qps, 1))
+            ttfts.append(sum(data) / len(data))
+        if qpses:
+            out[key] = (qpses, ttfts)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", default=".")
+    ap.add_argument("--out", default="multi-round.png")
+    args = ap.parse_args()
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    from matplotlib import pyplot as plt
+
+    curves = collect(args.dir)
+    if not curves:
+        raise SystemExit(f"no *_output_*.csv files under {args.dir!r} — "
+                         f"run benchmarks/run.sh first")
+    for key, (qpses, ttfts) in curves.items():
+        print(f"{key} avg TTFT", ttfts)
+        plt.plot(qpses, ttfts, label=key, linewidth=2, markersize=8,
+                 **_STYLE.get(key, {"marker": "^"}))
+    plt.xlabel("QPS")
+    plt.ylabel("Average TTFT (s)")
+    plt.legend()
+    plt.grid(True, alpha=0.3)
+    plt.tight_layout()
+    plt.savefig(args.out, dpi=150)
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

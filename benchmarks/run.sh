@@ -1,0 +1,89 @@
+#!/bin/bash
+# QPS-sweep benchmark procedure (reference benchmarks/multi-round-qa/run.sh).
+#
+# Usage: ./run.sh <model> <base url> <save file key> [launch]
+#   model          served model name (e.g. llama-1b)
+#   base url       router URL (e.g. http://localhost:30080)
+#   save file key  output prefix: {key}_output_{qps}.csv per QPS point
+#   launch         pass "launch" to bring up an engine+router stack locally
+#                  first (benchmarks/stack.py) and sweep against it
+#
+# Afterwards: python3 benchmarks/plot.py to draw the TTFT-vs-QPS curve.
+set -e
+
+if [[ $# -lt 3 ]]; then
+    echo "Usage: $0 <model> <base url> <save file key> [launch]"
+    exit 1
+fi
+
+MODEL=$1
+BASE_URL=$2
+KEY=$3
+REPO_ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO_ROOT"
+
+if [[ "${4:-}" == "launch" ]]; then
+    eval "$(python3 - "$MODEL" <<'EOF'
+import sys
+from benchmarks.stack import launch_stack
+stack = launch_stack(sys.argv[1], routing_logic="session",
+                     router_args=["--session-key", "x-user-id"])
+print(f"BASE_URL={stack.router_url}")
+print(f"STACK_PIDS='{stack.engine.pid} {stack.router.pid}'")
+EOF
+)"
+    trap 'kill $STACK_PIDS 2>/dev/null || true' EXIT
+    echo "Launched stack at $BASE_URL"
+fi
+
+# Workload shape (reference run.sh CONFIGURATION block; answer/system sizes
+# identical, users scaled to a single-host sweep — override via env).
+NUM_USERS=${NUM_USERS:-320}
+NUM_ROUNDS=${NUM_ROUNDS:-10}
+SYSTEM_PROMPT_WORDS=${SYSTEM_PROMPT_WORDS:-150}   # ~1000 tok system prompt
+ANSWER_LEN=${ANSWER_LEN:-100}
+TIME_LIMIT=${TIME_LIMIT:-100}
+NUM_USERS_WARMUP=${NUM_USERS_WARMUP:-400}
+
+warmup() {
+    python3 -m benchmarks.multi_round_qa \
+        --num-users 1 \
+        --num-rounds 2 \
+        --qps 2 \
+        --system-prompt-words "$SYSTEM_PROMPT_WORDS" \
+        --answer-tokens "$ANSWER_LEN" \
+        --model "$MODEL" \
+        --base-url "$BASE_URL" \
+        --output /tmp/warmup.csv \
+        --time $((NUM_USERS_WARMUP / 2))
+}
+
+run_benchmark() {
+    # $1: qps   $2: output file
+    python3 -m benchmarks.multi_round_qa \
+        --num-users "$NUM_USERS" \
+        --num-rounds "$NUM_ROUNDS" \
+        --qps "$1" \
+        --system-prompt-words "$SYSTEM_PROMPT_WORDS" \
+        --answer-tokens "$ANSWER_LEN" \
+        --model "$MODEL" \
+        --base-url "$BASE_URL" \
+        --output "$2" \
+        --time "$TIME_LIMIT"
+    sleep 10
+}
+
+warmup
+
+# Reference sweep order: ascending for the naive baseline, descending
+# otherwise (prefix caches warm at high load first).
+if [[ "$KEY" == "naive" ]]; then
+    QPS_VALUES=(0.1 0.5 0.9 1.3 1.7 2.1 2.5 2.9 3.3 3.7 4.1)
+else
+    QPS_VALUES=(4.1 3.7 3.3 2.9 2.5 2.1 1.7 1.3 0.9 0.5 0.1)
+fi
+
+for qps in "${QPS_VALUES[@]}"; do
+    output_file="${KEY}_output_${qps}.csv"
+    run_benchmark "$qps" "$output_file"
+done

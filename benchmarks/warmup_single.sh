@@ -1,0 +1,23 @@
+#!/bin/bash
+# Single-pod warmup pass (reference benchmarks/multi-round-qa/warmup_single.sh):
+# primes the engine's prefix cache + compiled shape families before a
+# single-GPU/-chip comparison run (tutorial 07 procedure).
+set -e
+
+MODEL=$1
+BASE_URL=$2
+NUM_USERS_WARMUP=${NUM_USERS_WARMUP:-20}
+SYSTEM_PROMPT_WORDS=${SYSTEM_PROMPT_WORDS:-150}
+ANSWER_LEN=${ANSWER_LEN:-100}
+
+cd "$(dirname "$0")/.."
+python3 -m benchmarks.multi_round_qa \
+    --num-users 1 \
+    --num-rounds 2 \
+    --qps 2 \
+    --system-prompt-words "$SYSTEM_PROMPT_WORDS" \
+    --answer-tokens "$ANSWER_LEN" \
+    --model "$MODEL" \
+    --base-url "$BASE_URL" \
+    --output /tmp/warmup.csv \
+    --time $((NUM_USERS_WARMUP / 2))

@@ -1,0 +1,126 @@
+"""Benchmark sweep tooling (VERDICT r4 #4): QPS-paced harness with CSV
+output, plot.py curve generation, ShareGPT preprocessing — the reference
+benchmarks/multi-round-qa/{run.sh,plot.py,data_preprocessing.py}
+procedure, driven here against the protocol-faithful fake engine."""
+
+import json
+import os
+import subprocess
+import sys
+
+from aiohttp.test_utils import TestServer
+
+from benchmarks.multi_round_qa import (
+    WorkloadConfig,
+    run_workload,
+    summarize,
+    write_csv,
+)
+from tests.fake_engine import FakeEngine
+
+
+async def test_qps_paced_csv_workload(tmp_path):
+    fake = FakeEngine(model="m", speed=2000.0)
+    server = TestServer(fake.build_app())
+    await server.start_server()
+    try:
+        cfg = WorkloadConfig(
+            base_url=str(server.make_url("")).rstrip("/"),
+            model="m", num_users=4, num_rounds=2, answer_tokens=8,
+            qps=50.0, time_limit_s=30.0,
+        )
+        records = await run_workload(cfg)
+        assert len(records) == 8  # 4 users x 2 rounds, inside the limit
+        # QPS pacing ordered session starts
+        launches = sorted(
+            (r.launch_time for r in records if r.round == 0)
+        )
+        assert launches[-1] - launches[0] >= 0.05  # 3 gaps of 1/50 s
+        csv_path = tmp_path / "stack_output_0.5.csv"
+        write_csv(records, str(csv_path))
+        import pandas as pd
+
+        df = pd.read_csv(csv_path)
+        assert "ttft" in df.columns and len(df) == 8
+        assert (df["ttft"] >= 0).all()
+        summary = summarize(records)
+        assert summary["finished_requests"] == 8
+    finally:
+        await server.close()
+
+
+async def test_time_limit_bounds_rounds(tmp_path):
+    fake = FakeEngine(model="m", speed=2000.0)
+    server = TestServer(fake.build_app())
+    await server.start_server()
+    try:
+        cfg = WorkloadConfig(
+            base_url=str(server.make_url("")).rstrip("/"),
+            model="m", num_users=2, num_rounds=50, answer_tokens=4,
+            time_limit_s=0.0,  # expired immediately: no NEW rounds start
+        )
+        records = await run_workload(cfg)
+        assert records == []
+    finally:
+        await server.close()
+
+
+def test_plot_builds_curve_from_sweep_csvs(tmp_path):
+    import pandas as pd
+
+    for key, base in (("stack", 0.2), ("naive", 0.9)):
+        for qps in (0.1, 0.5, 0.9):
+            pd.DataFrame({
+                "ttft": [base + qps / 10, base + qps / 5],
+            }).to_csv(tmp_path / f"{key}_output_{qps}.csv", index=False)
+    from benchmarks.plot import collect
+
+    curves = collect(str(tmp_path))
+    assert set(curves) == {"stack", "naive"}
+    qpses, ttfts = curves["stack"]
+    assert qpses == [0.1, 0.5, 0.9]
+    assert ttfts == sorted(ttfts)  # grows with load by construction
+    # one command draws the curve image
+    out = tmp_path / "multi-round.png"
+    subprocess.run(
+        [sys.executable, os.path.join("benchmarks", "plot.py"),
+         "--dir", str(tmp_path), "--out", str(out)],
+        check=True, cwd=os.path.join(os.path.dirname(__file__), ".."),
+    )
+    assert out.exists() and out.stat().st_size > 1000
+
+
+def test_sharegpt_preprocessing_and_questions(tmp_path):
+    raw = [
+        {"conversations": [
+            {"from": "human", "value": "what is a tpu"},
+            {"from": "gpt", "value": "a matrix machine " * 10},
+            {"from": "human", "value": "how fast is it"},
+            {"from": "gpt", "value": "quite fast"},
+        ]},
+        {"conversations": [{"from": "gpt", "value": "no human turn"}]},
+    ]
+    src = tmp_path / "sharegpt.json"
+    src.write_text(json.dumps(raw))
+    out = tmp_path / "processed.json"
+    subprocess.run(
+        [sys.executable, os.path.join("benchmarks", "data_preprocessing.py"),
+         "--input", str(src), "--output", str(out)],
+        check=True, cwd=os.path.join(os.path.dirname(__file__), ".."),
+    )
+    processed = json.loads(out.read_text())
+    # the no-human conversation is dropped; stats are annotated
+    assert len(processed) == 1
+    d = processed[0]
+    assert d["num_round"] == 4
+    assert d["max_human_token"] >= d["average_human_token"] > 0
+    assert d["conversations"][1]["num_tokens"] > 10
+
+    # the harness draws questions from the processed conversations
+    cfg = WorkloadConfig(sharegpt=processed, num_users=1)
+    from benchmarks.multi_round_qa import UserSession
+
+    s = UserSession(cfg, 0, "sys")
+    assert "what is a tpu" in s._question(0)
+    assert "how fast is it" in s._question(1)
+    assert "round 2" in s._question(2)  # exhausted -> synthetic fallback
