@@ -1,0 +1,11 @@
+# backend.tf — local state by default; point at a GCS bucket for teams.
+terraform {
+  required_providers {
+    google = {
+      source = "hashicorp/google"
+    }
+    time = {
+      source = "hashicorp/time"
+    }
+  }
+}

@@ -1,0 +1,47 @@
+# cluster.tf — mirrors the reference cluster config (release channel,
+# managed prometheus, VPC-native) with TPU API enablement.
+resource "google_container_cluster" "primary" {
+  name     = var.cluster_name
+  location = var.zone
+
+  deletion_protection      = false
+  remove_default_node_pool = true
+  initial_node_count       = 1
+
+  release_channel {
+    channel = "REGULAR"
+  }
+
+  logging_config {
+    enable_components = ["SYSTEM_COMPONENTS", "WORKLOADS"]
+  }
+
+  monitoring_config {
+    enable_components = [
+      "SYSTEM_COMPONENTS", "STORAGE", "POD", "DEPLOYMENT",
+      "STATEFULSET", "DAEMONSET", "HPA", "CADVISOR", "KUBELET",
+    ]
+    managed_prometheus {
+      enabled = true
+    }
+  }
+
+  networking_mode = "VPC_NATIVE"
+  network         = "default"
+  subnetwork      = "default"
+  ip_allocation_policy {}
+
+  addons_config {
+    horizontal_pod_autoscaling {
+      disabled = false
+    }
+    http_load_balancing {
+      disabled = false
+    }
+    gce_persistent_disk_csi_driver_config {
+      enabled = true
+    }
+  }
+
+  depends_on = [time_sleep.wait_60_seconds]
+}

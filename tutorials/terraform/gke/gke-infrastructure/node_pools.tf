@@ -1,0 +1,74 @@
+# node_pools.tf — a CPU pool for the router/controllers and a TPU v5e pool
+# for the serving engines. TPU pools use placement_policy tpu_topology (the
+# GKE TPU provisioning model) instead of the GPU path's guest_accelerator.
+resource "google_container_node_pool" "cpu_pool" {
+  name       = "${var.cluster_name}-cpu-pool"
+  location   = var.zone
+  cluster    = google_container_cluster.primary.name
+  node_count = 1
+
+  node_config {
+    image_type   = "COS_CONTAINERD"
+    machine_type = "e2-standard-8"
+    disk_type    = "pd-balanced"
+    disk_size_gb = 100
+
+    metadata = {
+      disable-legacy-endpoints = "true"
+    }
+    oauth_scopes = [
+      "https://www.googleapis.com/auth/devstorage.read_only",
+      "https://www.googleapis.com/auth/logging.write",
+      "https://www.googleapis.com/auth/monitoring",
+      "https://www.googleapis.com/auth/servicecontrol",
+      "https://www.googleapis.com/auth/service.management.readonly",
+      "https://www.googleapis.com/auth/trace.append",
+    ]
+    labels = {
+      env = var.project
+      app = "pstpu-router"
+    }
+  }
+}
+
+resource "google_container_node_pool" "tpu_pool" {
+  name       = "${var.cluster_name}-tpu-pool"
+  location   = var.zone
+  cluster    = google_container_cluster.primary.name
+  node_count = 2 # 2 x ct5lp-hightpu-4t = one v5e-8 (2x4) slice
+
+  placement_policy {
+    type         = "COMPACT"
+    tpu_topology = var.tpu_topology
+  }
+
+  node_config {
+    image_type   = "COS_CONTAINERD"
+    machine_type = var.tpu_machine_type
+    disk_type    = "pd-balanced"
+    disk_size_gb = 100
+
+    metadata = {
+      disable-legacy-endpoints = "true"
+    }
+    oauth_scopes = [
+      "https://www.googleapis.com/auth/devstorage.read_only",
+      "https://www.googleapis.com/auth/logging.write",
+      "https://www.googleapis.com/auth/monitoring",
+      "https://www.googleapis.com/auth/servicecontrol",
+      "https://www.googleapis.com/auth/service.management.readonly",
+      "https://www.googleapis.com/auth/trace.append",
+    ]
+    labels = {
+      env = var.project
+      app = "pstpu-engine"
+      "cloud.google.com/gke-tpu-accelerator" = "tpu-v5-lite-podslice"
+      "cloud.google.com/gke-tpu-topology"    = var.tpu_topology
+    }
+    taint {
+      key    = "google.com/tpu"
+      value  = "present"
+      effect = "NO_SCHEDULE"
+    }
+  }
+}

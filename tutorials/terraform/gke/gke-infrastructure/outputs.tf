@@ -1,0 +1,8 @@
+# outputs.tf
+output "cluster_name" {
+  value = google_container_cluster.primary.name
+}
+
+output "tpu_pool" {
+  value = google_container_node_pool.tpu_pool.name
+}

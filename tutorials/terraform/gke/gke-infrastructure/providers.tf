@@ -1,0 +1,7 @@
+# providers.tf — GKE TPU cluster provisioning (TPU-native replacement for
+# reference tutorials/terraform/gke/gke-infrastructure/providers.tf).
+provider "google" {
+  credentials = file(var.credentials_file)
+  project     = var.project
+  zone        = var.zone
+}

@@ -1,0 +1,46 @@
+# variables.tf
+variable "credentials_file" {
+  description = "google credentials file"
+  type        = string
+  default     = "../credentials.json"
+}
+
+variable "project" {
+  description = "GCP project id"
+  type        = string
+}
+
+variable "cluster_name" {
+  type    = string
+  default = "production-stack"
+}
+
+variable "zone" {
+  description = "zone with v5e capacity (see gcloud compute tpus locations)"
+  type        = string
+  default     = "us-central2-b"
+}
+
+# TPU node pools are keyed by machine type + topology, not guest
+# accelerators (the GPU path's guest_accelerator block does not apply):
+# ct5lp-hightpu-4t = v5e, 4 chips per VM; a 2x4 topology gives the v5e-8
+# slice the BASELINE.md target configuration uses.
+variable "tpu_machine_type" {
+  type    = string
+  default = "ct5lp-hightpu-4t"
+}
+
+variable "tpu_topology" {
+  type    = string
+  default = "2x4"
+}
+
+variable "gcp_services" {
+  type = list(string)
+  default = [
+    "container.googleapis.com",
+    "tpu.googleapis.com",
+    "monitoring.googleapis.com",
+    "logging.googleapis.com",
+  ]
+}

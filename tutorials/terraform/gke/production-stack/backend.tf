@@ -1,0 +1,8 @@
+# backend.tf
+terraform {
+  required_providers {
+    helm = {
+      source = "hashicorp/helm"
+    }
+  }
+}

@@ -1,0 +1,39 @@
+# helm.tf — the TPU stack needs NO device-plugin release: GKE TPU node
+# pools expose google.com/tpu natively (the reference installs the NVIDIA
+# device plugin here). The chart is installed from the in-repo path.
+resource "helm_release" "pstpu" {
+  name  = "pstpu"
+  chart = var.chart_path
+
+  values = [
+    file(var.setup_yaml)
+  ]
+}
+
+resource "helm_release" "kube_prometheus_stack" {
+  name             = "kube-prom-stack"
+  repository       = "https://prometheus-community.github.io/helm-charts"
+  chart            = "kube-prometheus-stack"
+  namespace        = "monitoring"
+  create_namespace = true
+  wait             = true
+
+  values = [
+    file(var.prom_stack_yaml)
+  ]
+}
+
+resource "helm_release" "prometheus_adapter" {
+  name       = "prometheus-adapter"
+  repository = "https://prometheus-community.github.io/helm-charts"
+  chart      = "prometheus-adapter"
+  namespace  = "monitoring"
+
+  values = [
+    file(var.prom_adapter_yaml)
+  ]
+
+  depends_on = [
+    helm_release.kube_prometheus_stack
+  ]
+}

@@ -1,0 +1,8 @@
+# providers.tf — helm releases into the TPU cluster created by
+# ../gke-infrastructure (run `gcloud container clusters get-credentials`
+# first; the Makefile does).
+provider "helm" {
+  kubernetes {
+    config_path = "~/.kube/config"
+  }
+}

@@ -1,0 +1,22 @@
+# variables.tf
+variable "setup_yaml" {
+  description = "chart values for the stack (e.g. ../../assets/values-02-basic-config.yaml)"
+  type        = string
+  default     = "setup.yaml"
+}
+
+variable "prom_stack_yaml" {
+  type    = string
+  default = "kube-prom-stack.yaml"
+}
+
+variable "prom_adapter_yaml" {
+  type    = string
+  default = "prom-adapter.yaml"
+}
+
+variable "chart_path" {
+  description = "local path to this repo's helm chart"
+  type        = string
+  default     = "../../../helm"
+}
