@@ -63,6 +63,8 @@ def bench_stack(args) -> dict:
         engine_args=[
             "--max-model-len", str(args.max_model_len),
             "--max-num-seqs", str(max(8, args.users)),
+            *(["--decode-loop", args.decode_loop]
+              if args.decode_loop else []),
         ],
         routing_logic="session",
         router_args=["--session-key", "x-user-id"],
@@ -223,6 +225,9 @@ def main():
     # window-copy memory wall (paged decode; bucketed window for head_dim<128
     # models) — VERDICT r2 weak #2 demanded the bench stop pinning 1024.
     ap.add_argument("--max-model-len", type=int, default=8192)
+    ap.add_argument("--decode-loop", default=None,
+                    choices=["while", "scan"],
+                    help="A/B the fused-decode loop construct (stack mode)")
     args = ap.parse_args()
 
     # Probe the backend in a SUBPROCESS: in stack mode the parent must not
