@@ -182,6 +182,7 @@ def bench_engine(args) -> dict:
         max_num_seqs=max(8, args.users),
         max_num_batched_tokens=1024,
         num_kv_blocks=None if on_tpu else 2048,
+        **({"decode_loop": args.decode_loop} if args.decode_loop else {}),
     )
     engine = ServingEngine(cfg)
 
@@ -227,7 +228,7 @@ def main():
     ap.add_argument("--max-model-len", type=int, default=8192)
     ap.add_argument("--decode-loop", default=None,
                     choices=["while", "scan"],
-                    help="A/B the fused-decode loop construct (stack mode)")
+                    help="A/B the fused-decode loop construct")
     args = ap.parse_args()
 
     # Probe the backend in a SUBPROCESS: in stack mode the parent must not

@@ -1078,13 +1078,20 @@ class ModelRunner:
         the dispatch outputs, so pool contents (beyond the never-read null
         block) survive warmup untouched.
 
-        Sampling-variant families (logprobs / penalties — static args, so
-        the default path compiles none of their code) are warmed for every
-        decode family and the single-row prefill families: a first
-        logprobs request mid-serving would otherwise stall all co-batched
-        traffic for a compile (advisor r4 low #4). With the persistent
-        compilation cache (config.compilation_cache_dir) the XLA work is
-        paid once per machine, not once per process.
+        Sampling-variant coverage contract (a mid-serving compile stalls
+        the single dispatch executor, so the variants co-batched traffic
+        can pull in are warmed; the rest pay a ONE-TIME persistent-cached
+        compile on first use — advisor r4 low #4, r5 review):
+          * default (no logprobs/penalties): every family;
+          * logprobs: every decode family and every single-row prefill
+            family (any chat+logprobs request reaches these);
+          * penalties: the interactive families only (b=1 decode, the
+            floor-width single-row prefill);
+          * multi-row prefill with variants, penalty+logprobs combos:
+            first-use compile, persistent-cached thereafter.
+        With the persistent compilation cache
+        (config.compilation_cache_dir) all of this is paid once per
+        machine, not once per process.
 
         Cost note: under the default decode_loop="while" the dummy decode
         executions run ZERO loop iterations (budget 0). Under "scan" each
@@ -1109,7 +1116,8 @@ class ModelRunner:
         wins = {}
         try:
             for db, mb, dk, cached in self.reachable_decode_families():
-                for pen, lpk in variants:
+                dvariants = variants if db == 1 else variants[:2]
+                for pen, lpk in dvariants:
                     if cached:
                         wk, wv = wins[(db, mb)]
                     else:
@@ -1132,8 +1140,23 @@ class ModelRunner:
                         # windows; the inputs were donated, so rebind.
                         wins[(db, mb)] = (out[3], out[4])
                     n_warmed += 1
+            t_floor = prefill_t_floor(cfg.max_num_batched_tokens)
             for pb, t, mb, has_window in self.reachable_prefill_families():
-                for pen, lpk in variants if pb == 1 else variants[:1]:
+                # Coverage contract (mirrors the docstring): logprobs
+                # variants warm for every single-row prefill family (any
+                # chat+logprobs prompt length/history hits one); penalties
+                # only at the interactive floor family — they engage on
+                # prefill only for preempted re-prefills, a rare path
+                # whose other combinations pay a one-time
+                # persistent-cached compile.
+                if pb == 1:
+                    pvariants = (
+                        variants if t == t_floor and not has_window
+                        else (variants[0], variants[1])
+                    )
+                else:
+                    pvariants = variants[:1]
+                for pen, lpk in pvariants:
                     counts = jnp.zeros(
                         (pb, mc.vocab_size) if pen else (1, 1), jnp.int32
                     )

@@ -60,20 +60,20 @@ def round_up(x: int, multiple: int) -> int:
 def window_mb_bucket(live_blocks: int, max_blocks: int) -> int:
     """Block-table bucket for dispatches whose COST scales with mb (the
     gathered-window paths): the power-of-two bucket of the live block count,
-    floored at 1/8 of the max bucket.
+    floored at 1/4 of the max bucket.
 
-    The floor bounds the reachable family count at four (full/8, full/4,
-    full/2, full) so runner.warmup() can AOT-compile every windowed family
-    a serving process can ever dispatch — the round-4 bench regression was
+    The floor bounds the reachable family count at three (full/4, full/2,
+    full) so runner.warmup() can AOT-compile every windowed family a
+    serving process can ever dispatch — the round-4 bench regression was
     exactly a live-bucketed mb family that warmup never compiled landing a
     multi-second XLA compile inside the timed region (VERDICT r4 weak #1).
     The padding cost is bounded: a window is never gathered more than 2x
-    (above the floor) or max_bucket/8 blocks (below it) larger than live.
+    (above the floor) or max_bucket/4 blocks (below it) larger than live.
 
     Shared by the runner (dispatch shapes) and the scheduler (window-budget
     accounting): they must agree or the budget check under-counts."""
     full = pow2_bucket(max_blocks, 1, max(1, max_blocks))
-    return pow2_bucket(live_blocks, max(1, full // 8), full)
+    return pow2_bucket(live_blocks, max(1, full // 4), full)
 
 
 def prefill_t_floor(token_budget: int) -> int:
