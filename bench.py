@@ -63,6 +63,7 @@ def bench_stack(args) -> dict:
         engine_args=[
             "--max-model-len", str(args.max_model_len),
             "--max-num-seqs", str(max(8, args.users)),
+            "--attn-impl", args.attn_impl,
             *(["--decode-loop", args.decode_loop]
               if args.decode_loop else []),
         ],
@@ -229,6 +230,9 @@ def main():
     ap.add_argument("--decode-loop", default=None,
                     choices=["while", "scan"],
                     help="A/B the fused-decode loop construct")
+    ap.add_argument("--attn-impl", default="auto",
+                    choices=["auto", "window", "paged", "xla", "pallas"],
+                    help="A/B the decode attention implementation")
     args = ap.parse_args()
 
     # Probe the backend in a SUBPROCESS: in stack mode the parent must not

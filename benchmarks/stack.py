@@ -71,7 +71,7 @@ def launch_stack(
     router_args: Optional[List[str]] = None,
     routing_logic: str = "session",
     served_model: Optional[str] = None,
-    startup_timeout_s: float = 900.0,
+    startup_timeout_s: float = 1800.0,
     log_dir: str = "/tmp",
 ) -> StackHandle:
     """Start engine + router; block until both are healthy."""

@@ -69,6 +69,12 @@ class EngineConfig:
     # workload-dependent (VERDICT r4 weak #2 demanded the comparison be
     # runnable, not asserted).
     decode_loop: str = "while"
+    # Pipelined engine loop: issue dispatch N+1 before fetching N's tokens
+    # (device-chained start tokens; scheduler state advanced speculatively
+    # at issue). Hides the blocking device->host sync — ~100 ms of tunnel
+    # round-trip per dispatch on the benched deployment, the single
+    # largest serving cost. False restores strict issue-fetch-apply.
+    async_pipeline: bool = True
     # --- KV offload (LMCache-equivalent; env names mirror the reference chart)
     kv_offload_cpu: bool = field(
         default_factory=lambda: os.environ.get("LMCACHE_LOCAL_CPU", "").lower() == "true"

@@ -254,11 +254,64 @@ class ServingEngine:
 
     # ------------------------------------------------------------ engine loop
     async def _run_loop(self) -> None:
+        """Depth-1 pipelined dispatch loop (config.async_pipeline).
+
+        Each iteration ISSUES the next dispatch (cheap — enqueue only, no
+        device sync) and only then FETCHES the previous one's tokens, so
+        the blocking device->host round-trip (~100 ms of tunnel RTT per
+        dispatch on the benched deployment — the dominant serving cost)
+        overlaps the new dispatch's execution. The scheduler's state is
+        advanced speculatively at issue (advance_at_issue) and tokens are
+        delivered at fetch (apply_results); rows that finish or get
+        preempted while a dispatch is in flight simply discard its tokens
+        for them (epoch check), and the next dispatch's start tokens ride
+        the device-resident chain vector, never the host."""
         loop = asyncio.get_running_loop()
+        in_flight = None  # (batch, DispatchHandle)
+        pipeline = self.config.async_pipeline
+
+        def abort_batch(batch):
+            for seq in batch.seqs:
+                aborted = self.scheduler.abort(seq.request_id)
+                if aborted is not None:
+                    self._process_output(aborted)
+
+        async def apply_in_flight():
+            nonlocal in_flight
+            if in_flight is None:
+                return
+            batch, handle = in_flight
+            in_flight = None
+            try:
+                tokens, lps = await loop.run_in_executor(None, handle.fetch)
+            except Exception:  # noqa: BLE001 — engine loop must survive
+                logger.exception("Dispatch fetch failed; aborting batch")
+                abort_batch(batch)
+                return
+            if self._dispatch_log is not None:
+                self._dispatch_log.write(
+                    f"{batch.kind} rows={len(batch.seqs)} "
+                    f"kt={batch.num_steps if batch.kind == 'decode' else max(batch.chunk_lens)} "
+                    f"ms={(time.monotonic() - handle.issue_time) * 1000:.1f}\n"
+                )
+                self._dispatch_log.flush()
+            self.last_step_time = time.monotonic()
+            produced, accepted = self.scheduler.apply_results(
+                batch, tokens, lps
+            )
+            self.generation_tokens_total += accepted
+            for seq in produced:
+                self._process_output(seq)
+
         while self._running:
             self._apply_pending_aborts()
             batch = self.scheduler.schedule()
             if batch is None:
+                if in_flight is not None:
+                    # Applying may finish rows and free blocks, unblocking
+                    # admission — re-schedule right after.
+                    await apply_in_flight()
+                    continue
                 self._new_work.clear()
                 # Idle: drop the persistent decode window so its (up to
                 # window-budget-sized) device buffers don't pin HBM.
@@ -273,35 +326,37 @@ class ServingEngine:
                     # in-flight requests) — yield and retry.
                     await asyncio.sleep(0.001)
                 continue
+            # Penalty counts are built from APPLIED tokens; drain the
+            # pipeline first so they are exact.
+            if in_flight is not None and any(
+                s.sampling.presence_penalty or s.sampling.frequency_penalty
+                for s in batch.seqs
+            ):
+                await apply_in_flight()
             step = self._step_counter
             self._step_counter += 1
             try:
-                t0 = time.monotonic()
-                next_tokens, logprob_lists = await loop.run_in_executor(
-                    None, self.runner.execute, batch, step
+                # Issue in the executor: normally enqueue-only (~ms), but
+                # a cold shape family compiles for seconds and a penalty
+                # batch builds [b, vocab] counts — neither may freeze the
+                # event loop (SSE, health). Runner state stays effectively
+                # single-threaded: issue and fetch are each awaited before
+                # the next runner call.
+                handle = await loop.run_in_executor(
+                    None, self.runner.execute_async, batch, step
                 )
-                if self._dispatch_log is not None:
-                    self._dispatch_log.write(
-                        f"{batch.kind} rows={len(batch.seqs)} "
-                        f"kt={batch.num_steps if batch.kind == 'decode' else max(batch.chunk_lens)} "
-                        f"ms={(time.monotonic() - t0) * 1000:.1f}\n"
-                    )
-                    self._dispatch_log.flush()
             except Exception:  # noqa: BLE001 — engine loop must survive
-                logger.exception("Model step failed; aborting batch")
-                for seq in batch.seqs:
-                    aborted = self.scheduler.abort(seq.request_id)
-                    if aborted is not None:
-                        self._process_output(aborted)
+                logger.exception("Dispatch issue failed; aborting batch")
+                abort_batch(batch)
                 continue
-            self.last_step_time = time.monotonic()
-            produced, accepted = self.scheduler.update_after_step(
-                batch, next_tokens, logprob_lists
-            )
-            self.generation_tokens_total += accepted
-            for seq in produced:
-                self._process_output(seq)
+            self.scheduler.advance_at_issue(batch)
+            await apply_in_flight()
+            in_flight = (batch, handle)
+            if not pipeline:
+                await apply_in_flight()
             await asyncio.sleep(0)
+        # Drain on shutdown so no accepted tokens are lost.
+        await apply_in_flight()
 
     def _apply_pending_aborts(self) -> None:
         while self._pending_aborts:

@@ -23,6 +23,7 @@ was profiled on a v5e in round 1/2):
 """
 
 import functools
+import time
 from typing import Dict, List, Optional
 
 import jax
@@ -51,8 +52,13 @@ _SEED_MULT = np.uint32(1000003)
 _POS_SENTINEL = np.int32(2**30)  # ring_pos value for not-yet-written entries
 # int32 per-row scalar rows at the head of each packed host buffer; row 8 is
 # the LoRA adapter index (0 = base model); rows 9/10 are the
-# presence/frequency penalties (floats bitcast).
-NUM_SCALARS = 11
+# presence/frequency penalties (floats bitcast); row 11 is the TOKEN-CHAIN
+# source: an index into the PREVIOUS dispatch's device-resident last-token
+# vector (-1 = use the host tokens0 in row 0). Chaining lets the engine
+# issue dispatch N+1 before fetching N's tokens — the blocking
+# device->host sync (~100 ms of tunnel RTT on the benched deployment, the
+# dominant serving cost) then overlaps N+1's execution.
+NUM_SCALARS = 12
 # Static buckets for the per-dispatch top-logprobs width: OpenAI completions
 # allows logprobs<=5, chat top_logprobs<=20; two buckets bound the compiled
 # variant count. 0 = the (default) no-logprobs variants.
@@ -90,6 +96,29 @@ def _token_seed(seq: Sequence, gen_index: int) -> np.uint32:
 
 
 _cache_configured = False
+
+
+class DispatchHandle:
+    """An issued device dispatch whose results are fetched lazily.
+
+    fetch() performs the blocking device->host sync (idempotent; caches
+    the result). The pipelined engine loop issues the NEXT dispatch before
+    fetching, so the sync overlaps device execution."""
+
+    __slots__ = ("_fetch", "_result", "_done", "issue_time")
+
+    def __init__(self, fetch_fn):
+        self._fetch = fetch_fn
+        self._result = None
+        self._done = False
+        self.issue_time = time.monotonic()
+
+    def fetch(self):
+        if not self._done:
+            self._result = self._fetch()
+            self._done = True
+            self._fetch = None
+        return self._result
 
 
 def _setup_compilation_cache(cache_dir: str) -> None:
@@ -212,9 +241,26 @@ class ModelRunner:
         # live KV every dispatch (~80-100 ms fixed cost at 16x2k-token rows
         # on a v5e — r3 profiling). {ids, b, mb, end[], win=(k, v)}.
         self._win_cache = None
+        # Token-chain state: the previous dispatch's device-resident
+        # last-token vector + row mapping ({request_id: row index}) and
+        # preemption epochs, so the next decode dispatch can start from
+        # tokens the host has not fetched yet (pipelined engine loop).
+        self._b_max = _bucket(config.max_num_seqs, 1,
+                              max(1, config.max_num_seqs))
+        self._chain = None
+        # COMMITTED + mesh-replicated, so its pjit cache key matches the
+        # chain vectors dispatches return (an uncommitted jnp.zeros would
+        # key a separate executable variant — the committed/uncommitted
+        # cache-key split that also bites the cached-window warmup).
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        self._zero_last = jax.device_put(
+            jnp.zeros((self._b_max,), jnp.int32),
+            NamedSharding(mesh, PartitionSpec()),
+        )
         self._prefill = jax.jit(
             self._prefill_impl,
-            static_argnames=("b", "t", "mb", "has_window",
+            static_argnames=("b", "t", "mb", "has_window", "b_max",
                              "has_penalties", "logprobs_k"),
             donate_argnums=(2, 3),
         )
@@ -325,7 +371,7 @@ class ModelRunner:
 
     # ------------------------------------------------------------------ decode
     def _decode_impl(self, params, packed, kv_k, kv_v, win_k_in, win_v_in,
-                     counts0, *, b: int, mb: int, num_steps: int,
+                     counts0, prev_last, *, b: int, mb: int, num_steps: int,
                      use_cached_window: bool, has_penalties: bool = False,
                      logprobs_k: int = 0):
         """One fused K-step decode dispatch.
@@ -343,6 +389,14 @@ class ModelRunner:
         (chosen_logprob [K, b], top_lp [K, b, k], top_ids [K, b, k]) from
         the RAW logits. Both knobs are static so the default serving path
         compiles no penalty/logprob code at all.
+
+        prev_last: [b_max] int32 — the PREVIOUS dispatch's device-resident
+        last-token vector. Rows whose packed chain_src (scalar row 11) is
+        >= 0 take tokens0 = prev_last[chain_src] instead of the host value,
+        so a dispatch can be issued before the previous one's tokens ever
+        reach the host (the pipelined engine loop). The dispatch RETURNS
+        its own last-token vector [b_max] (each row's final sampled token,
+        frozen at its step budget) as the last output.
 
         win_k_in/win_v_in: the persistent window buffers [L, Hkv, b, mb*bs,
         Dh] (window impl with ``use_cached_window``): they already hold the
@@ -367,8 +421,19 @@ class ModelRunner:
         adapter_idx = scalars[8]
         presence = jax.lax.bitcast_convert_type(scalars[9], jnp.float32)
         frequency = jax.lax.bitcast_convert_type(scalars[10], jnp.float32)
+        chain_src = scalars[11]
         lora = (adapter_idx, self.lora_stacks) if self.lora_stacks else None
         block_tables = packed[NUM_SCALARS * b:].reshape(b, mb)
+        b_max = prev_last.shape[0]
+
+        # Token chaining: rows continuing from the immediately-previous
+        # dispatch read their start token from its device-resident
+        # last-token vector (see docstring).
+        tokens0 = jnp.where(
+            chain_src >= 0,
+            prev_last[jnp.clip(chain_src, 0, b_max - 1)],
+            tokens0,
+        )
 
         # Per-step write slots [K, b] (0 = reserved null block for rows whose
         # budget ran out) and per-step seeds [K, b].
@@ -459,9 +524,13 @@ class ModelRunner:
             ring_pos = jax.lax.dynamic_update_slice(
                 ring_pos, positions, (0, j)
             )
-            return (
-                nxt.astype(jnp.int32), ring_k, ring_v, ring_pos, counts
-            ), nxt, lp
+            # The carried token freezes at each row's step budget, so the
+            # final carry is the row's LAST VALID sampled token — the
+            # chain vector the next dispatch may start from.
+            kept = jnp.where(
+                j < budget, nxt.astype(jnp.int32), toks
+            )
+            return (kept, ring_k, ring_v, ring_pos, counts), nxt, lp
 
         def loop_body(state):
             j, carry, toks_all, lp_bufs = state
@@ -483,9 +552,11 @@ class ModelRunner:
                 carry, nxt, lp = body(carry, j)
                 return carry, (nxt, lp if logprobs_k else ())
 
-            (_, ring_k, ring_v, _, _), (toks_all, lp_scan) = jax.lax.scan(
-                scan_body, carry0, jnp.arange(num_steps, dtype=jnp.int32)
-            )
+            (final_toks, ring_k, ring_v, _, _), (toks_all, lp_scan) = \
+                jax.lax.scan(
+                    scan_body, carry0,
+                    jnp.arange(num_steps, dtype=jnp.int32),
+                )
             lp_chosen, lp_top, lp_ids = lp_scan if logprobs_k else (
                 None, None, None
             )
@@ -496,7 +567,7 @@ class ModelRunner:
                 jnp.zeros((num_steps, b, logprobs_k), jnp.float32),
                 jnp.zeros((num_steps, b, logprobs_k), jnp.int32),
             ) if logprobs_k else ()
-            _, (_, ring_k, ring_v, _, _), toks_all, lp_bufs = \
+            _, (final_toks, ring_k, ring_v, _, _), toks_all, lp_bufs = \
                 jax.lax.while_loop(
                     lambda st: st[0] < n_active,
                     loop_body,
@@ -506,6 +577,7 @@ class ModelRunner:
                 lp_chosen, lp_top, lp_ids = lp_bufs
             else:
                 lp_chosen, lp_top, lp_ids = None, None, None
+        last_token = jnp.zeros((b_max,), jnp.int32).at[:b].set(final_toks)
 
         # ONE scatter writes the whole dispatch's KV back to the paged pool.
         flat_slots = slot_steps.reshape(-1)                       # [K*b]
@@ -531,11 +603,11 @@ class ModelRunner:
                 :, :, widx.reshape(-1)
             ].set(v_flat, mode="drop").reshape(nl, hkv, b, s_tot, dh)
             return (toks_all, kv_k, kv_v, win_k, win_v,
-                    lp_chosen, lp_top, lp_ids)                    # [K, b]
+                    lp_chosen, lp_top, lp_ids, last_token)        # [K, b]
         return (toks_all, kv_k, kv_v, win_k_in, win_v_in,
-                lp_chosen, lp_top, lp_ids)
+                lp_chosen, lp_top, lp_ids, last_token)
 
-    def _execute_decode(self, batch: ScheduledBatch) -> List[List[int]]:
+    def _issue_decode(self, batch: ScheduledBatch) -> "DispatchHandle":
         cfg = self.config
         seqs = batch.seqs
         k = batch.num_steps
@@ -556,13 +628,34 @@ class ModelRunner:
              if s.sampling.logprobs is not None),
             default=0,
         )
+        chain = self._chain
+        sc[11, :] = -1
         for i, s in enumerate(seqs):
             pos = s.num_computed_tokens
-            sc[0, i] = s.all_token_ids[pos]
+            # Token chaining: a row whose last sampled token still sits in
+            # the previous dispatch's device buffer (unapplied — the
+            # pipelined engine issues before fetching) reads it ON DEVICE;
+            # all other rows have fully-applied host tokens.
+            src = -1
+            if chain is not None:
+                src = chain["row"].get(s.request_id, -1)
+                if src >= 0 and chain["epoch"][s.request_id] != \
+                        s.num_preemptions:
+                    src = -1
+            if src >= 0:
+                sc[11, i] = src
+            else:
+                if pos >= len(s.all_token_ids):
+                    raise RuntimeError(
+                        f"row {s.request_id}: token at pos {pos} neither "
+                        f"applied on host nor chainable from the previous "
+                        f"dispatch (pipeline invariant breach)"
+                    )
+                sc[0, i] = s.all_token_ids[pos]
             sc[1, i] = pos
             sc[2, i] = batch.decode_steps[i]
             u32[3, i] = _seed_base(s)
-            u32[4, i] = len(s.output_token_ids)
+            u32[4, i] = len(s.output_token_ids) + s.inflight_steps
             sc[8, i] = s.adapter_idx
             sp = s.sampling
             f32[5, i] = sp.temperature
@@ -583,7 +676,6 @@ class ModelRunner:
         else:
             counts = np.zeros((1, 1), np.int32)
 
-        mc = self.model_config
         ids = tuple(s.request_id for s in seqs)
         cache = self._win_cache
         # The cached window is valid when the SAME ordered rows decode again
@@ -612,13 +704,14 @@ class ModelRunner:
             wk = jnp.zeros((1, 1, 1, 1, 1), self.dtype)
             wv = jnp.zeros((1, 1, 1, 1, 1), self.dtype)
 
-        toks_all, self.kv_k, self.kv_v, wk2, wv2, lp_c, lp_t, lp_i = \
-            self._decode(
-                self.params, jnp.asarray(packed), self.kv_k, self.kv_v,
-                wk, wv, jnp.asarray(counts),
-                b=b, mb=mb, num_steps=k, use_cached_window=use_cached,
-                has_penalties=has_penalties, logprobs_k=logprobs_k,
-            )
+        prev_last = chain["last"] if chain is not None else self._zero_last
+        (toks_all, self.kv_k, self.kv_v, wk2, wv2, lp_c, lp_t, lp_i,
+         last_token) = self._decode(
+            self.params, jnp.asarray(packed), self.kv_k, self.kv_v,
+            wk, wv, jnp.asarray(counts), prev_last,
+            b=b, mb=mb, num_steps=k, use_cached_window=use_cached,
+            has_penalties=has_penalties, logprobs_k=logprobs_k,
+        )
         if self.attn_impl != "paged":
             self._win_cache = {
                 "ids": ids, "b": b, "mb": mb,
@@ -628,17 +721,27 @@ class ModelRunner:
                 ],
                 "win": (wk2, wv2),
             }
-        out = np.asarray(toks_all)  # ONE [K, B] fetch per K*B tokens
-        tokens = [
-            [int(out[j, i]) for j in range(batch.decode_steps[i])]
-            for i in range(len(seqs))
-        ]
-        if not logprobs_k:
-            return tokens, None
-        return tokens, self._gather_logprobs(
-            seqs, batch.decode_steps, np.asarray(lp_c), np.asarray(lp_t),
-            np.asarray(lp_i),
-        )
+        self._chain = {
+            "last": last_token,
+            "row": {s.request_id: i for i, s in enumerate(seqs)},
+            "epoch": {s.request_id: s.num_preemptions for s in seqs},
+        }
+        steps = list(batch.decode_steps)
+        n = len(seqs)
+
+        def fetch():
+            out = np.asarray(toks_all)  # ONE [K, B] fetch per K*B tokens
+            tokens = [
+                [int(out[j, i]) for j in range(steps[i])] for i in range(n)
+            ]
+            if not logprobs_k:
+                return tokens, None
+            return tokens, self._gather_logprobs(
+                seqs, steps, np.asarray(lp_c), np.asarray(lp_t),
+                np.asarray(lp_i),
+            )
+
+        return DispatchHandle(fetch)
 
     @staticmethod
     def _gather_logprobs(seqs, steps, lp_c, lp_t, lp_i):
@@ -664,7 +767,7 @@ class ModelRunner:
 
     # ----------------------------------------------------------------- prefill
     def _prefill_impl(self, params, packed, kv_k, kv_v, counts0, *, b: int,
-                      t: int, mb: int, has_window: bool,
+                      t: int, mb: int, has_window: bool, b_max: int,
                       has_penalties: bool = False, logprobs_k: int = 0):
         """One (multi-sequence) prefill chunk dispatch.
 
@@ -757,9 +860,15 @@ class ModelRunner:
         flat_slots = slot_mapping.reshape(-1)                     # [b*t]
         kv_k = kv_k.at[:, :, flat_slots].set(k_new.reshape(nl, hkv, b * t, dh))
         kv_v = kv_v.at[:, :, flat_slots].set(v_new.reshape(nl, hkv, b * t, dh))
-        return next_tokens, kv_k, kv_v, lp[0], lp[1], lp[2]
+        # Device-resident last-token vector (final rows' sampled tokens):
+        # the first decode dispatch after this prefill may chain from it
+        # without a host roundtrip (see _decode_impl).
+        last_token = jnp.zeros((b_max,), jnp.int32).at[:b].set(
+            next_tokens.astype(jnp.int32)
+        )
+        return next_tokens, kv_k, kv_v, lp[0], lp[1], lp[2], last_token
 
-    def _execute_prefill(self, batch: ScheduledBatch) -> List[List[int]]:
+    def _issue_prefill(self, batch: ScheduledBatch) -> "DispatchHandle":
         cfg = self.config
         seqs = batch.seqs
         n = len(seqs)
@@ -829,36 +938,63 @@ class ModelRunner:
         else:
             counts = np.zeros((1, 1), np.int32)
 
-        next_tokens, self.kv_k, self.kv_v, lp_c, lp_t, lp_i = self._prefill(
-            self.params, jnp.asarray(packed), self.kv_k, self.kv_v,
-            jnp.asarray(counts),
-            b=b, t=t, mb=mb, has_window=has_window,
-            has_penalties=has_penalties, logprobs_k=logprobs_k,
-        )
-        if not any(finals):
-            # No row finished its prompt: skip the blocking fetch entirely.
-            return [[] for _ in range(n)], None
-        out = np.asarray(next_tokens)
-        tokens = [[int(out[i])] if finals[i] else [] for i in range(n)]
-        if not logprobs_k:
-            return tokens, None
-        lp = self._gather_logprobs(
-            seqs, [1 if f else 0 for f in finals],
-            np.asarray(lp_c)[None], np.asarray(lp_t)[None],
-            np.asarray(lp_i)[None],
-        )
-        return tokens, lp
+        next_tokens, self.kv_k, self.kv_v, lp_c, lp_t, lp_i, last_token = \
+            self._prefill(
+                self.params, jnp.asarray(packed), self.kv_k, self.kv_v,
+                jnp.asarray(counts),
+                b=b, t=t, mb=mb, has_window=has_window, b_max=self._b_max,
+                has_penalties=has_penalties, logprobs_k=logprobs_k,
+            )
+        # Final rows' sampled tokens are chainable by the next decode
+        # dispatch without a host roundtrip.
+        self._chain = {
+            "last": last_token,
+            "row": {
+                s.request_id: i for i, s in enumerate(seqs) if finals[i]
+            },
+            "epoch": {
+                s.request_id: s.num_preemptions
+                for i, s in enumerate(seqs) if finals[i]
+            },
+        }
+
+        def fetch():
+            if not any(finals):
+                # No row finished its prompt: no blocking fetch at all.
+                return [[] for _ in range(n)], None
+            out = np.asarray(next_tokens)
+            tokens = [[int(out[i])] if finals[i] else [] for i in range(n)]
+            if not logprobs_k:
+                return tokens, None
+            lp = self._gather_logprobs(
+                seqs, [1 if f else 0 for f in finals],
+                np.asarray(lp_c)[None], np.asarray(lp_t)[None],
+                np.asarray(lp_i)[None],
+            )
+            return tokens, lp
+
+        return DispatchHandle(fetch)
 
     # ---------------------------------------------------------------- execute
+    def execute_async(self, batch: ScheduledBatch,
+                      step_counter: int) -> "DispatchHandle":
+        """ISSUE one dispatch (async — returns before any device->host
+        sync). The returned handle's fetch() blocks on the results; the
+        pipelined engine loop issues the next dispatch first so that sync
+        overlaps device execution (the ~100 ms blocking round-trip per
+        dispatch was the dominant serving cost on the benched tunnel
+        deployment)."""
+        if batch.kind == "decode":
+            return self._issue_decode(batch)
+        return self._issue_prefill(batch)
+
     def execute(self, batch: ScheduledBatch, step_counter: int):
-        """Run one dispatch; returns (token_lists, logprob_lists):
+        """Synchronous issue+fetch; returns (token_lists, logprob_lists):
         per-sequence NEW token lists (empty for a non-final prefill chunk,
         whose sampled token is never fetched) and, when any row requested
         logprobs, per-sequence aligned (chosen_lp, top-k) entry lists
         (None otherwise — the default path fetches nothing extra)."""
-        if batch.kind == "decode":
-            return self._execute_decode(batch)
-        return self._execute_prefill(batch)
+        return self.execute_async(batch, step_counter).fetch()
 
     # -------------------------------------------------------------- embedding
     @functools.cached_property
@@ -1130,6 +1266,7 @@ class ModelRunner:
                         self.params,
                         jnp.zeros((NUM_SCALARS * db + db * mb,), jnp.int32),
                         self.kv_k, self.kv_v, wk, wv, counts,
+                        self._zero_last,
                         b=db, mb=mb, num_steps=dk,
                         use_cached_window=cached,
                         has_penalties=pen, logprobs_k=lpk,
@@ -1167,6 +1304,7 @@ class ModelRunner:
                         ),
                         self.kv_k, self.kv_v, counts,
                         b=pb, t=t, mb=mb, has_window=has_window,
+                        b_max=self._b_max,
                         has_penalties=pen, logprobs_k=lpk,
                     )
                     self.kv_k, self.kv_v = out[1], out[2]

@@ -82,6 +82,11 @@ class Sequence:
 
     status: SequenceStatus = SequenceStatus.WAITING
     output_token_ids: List[int] = field(default_factory=list)
+    # Tokens sampled by an ISSUED-but-unapplied dispatch (the pipelined
+    # engine advances state at issue and applies tokens at fetch): their KV
+    # is in the pool and their seeds consumed, but the ids are not yet on
+    # the host. num_computed_tokens already includes them.
+    inflight_steps: int = 0
     # Aligned with output_token_ids when sampling.logprobs is set: one
     # (chosen_logprob, [(token_id, logprob), ...]) per accepted token.
     output_logprobs: List = field(default_factory=list)
@@ -139,6 +144,11 @@ class ScheduledBatch:
     # excess writes masked to the null block and its excess tokens discarded).
     num_steps: int = 1
     decode_steps: List[int] = field(default_factory=list)
+    # Set by advance_at_issue: per-row preemption epochs (apply_results
+    # skips rows preempted while the dispatch was in flight) and, for
+    # prefill, which rows completed their prompt in this chunk.
+    epochs: List[int] = field(default_factory=list)
+    finals: List[bool] = field(default_factory=list)
 
     @property
     def num_tokens(self) -> int:
@@ -363,12 +373,20 @@ class Scheduler:
                 continue
             # Positions written this dispatch: pos .. pos+want-1. `want` is
             # capped by model-length capacity and the request's remaining
-            # token budget so the fused scan rarely computes discarded steps.
+            # token budget (counting in-flight unapplied tokens) so the
+            # fused scan rarely computes discarded steps.
             pos = seq.num_computed_tokens
+            produced = len(seq.output_token_ids) + seq.inflight_steps
+            if (
+                seq.sampling.max_tokens - produced <= 0
+                or self.config.max_model_len - pos <= 0
+            ):
+                # Fully dispatched: the in-flight apply will finish it.
+                continue
             want = max(1, min(
                 max_k,
                 self.config.max_model_len - pos,
-                seq.sampling.max_tokens - len(seq.output_token_ids),
+                seq.sampling.max_tokens - produced,
             ))
             need_blocks = (pos + want - 1) // bs + 1
             while len(seq.block_ids) < need_blocks:
@@ -470,38 +488,37 @@ class Scheduler:
         self.block_manager.free_blocks(seq.block_ids)
         seq.block_ids = []
         seq.num_computed_tokens = 0
+        # In-flight unapplied tokens are DISCARDED (apply_results skips
+        # rows whose preemption epoch changed); recompute-by-prefill
+        # regenerates them deterministically from the same seeds.
+        seq.inflight_steps = 0
         seq._prev_hash = seq.hash_seed
         seq._num_hashed_blocks = 0
         seq.status = SequenceStatus.WAITING
         self.waiting.appendleft(seq)
 
     # ------------------------------------------------------- post-step update
-    def update_after_step(
-        self, batch: ScheduledBatch, token_lists: List[List[int]],
-        logprob_lists=None,
-    ) -> tuple:
-        """Apply model outputs (a token list per sequence; empty for non-final
-        prefill chunks; ``logprob_lists`` aligned per-token entries when any
-        row requested logprobs). Returns (sequences that produced NEW
-        tokens, number of tokens accepted)."""
-        produced: List[Sequence] = []
-        accepted = 0
+    def advance_at_issue(self, batch: ScheduledBatch) -> None:
+        """Speculative state advance at dispatch ISSUE: KV positions, queue
+        transitions, and in-flight generation accounting — everything
+        schedule() needs to build the NEXT dispatch before this one's
+        sampled tokens reach the host. apply_results later delivers the
+        tokens (the pipelined engine issues N+1 between the two)."""
+        batch.epochs = [s.num_preemptions for s in batch.seqs]
         if batch.kind == "prefill":
             requeue: List[Sequence] = []
+            batch.finals = []
             for idx, seq in enumerate(batch.seqs):
                 if seq.status.is_finished:
-                    continue  # aborted while the step was in flight
+                    batch.finals.append(False)
+                    continue  # aborted while scheduling was in flight
                 seq.num_computed_tokens += batch.chunk_lens[idx]
-                self._register_full_blocks(seq)
-                if seq.num_computed_tokens >= seq.num_tokens:
-                    # Prefill complete: the sampled token is the next token.
-                    self._append_token(
-                        seq, token_lists[idx][0],
-                        logprob_lists[idx][0]
-                        if logprob_lists and logprob_lists[idx] else None,
-                    )
-                    accepted += 1
-                    produced.append(seq)
+                final = seq.num_computed_tokens >= seq.num_tokens
+                batch.finals.append(final)
+                if final:
+                    # Prompt complete: the sampled (in-flight) next token
+                    # moves the row to RUNNING for decode scheduling.
+                    seq.inflight_steps += 1
                     self.running.append(seq)
                 else:
                     # More chunks to go; requeue at the front (order kept).
@@ -509,27 +526,78 @@ class Scheduler:
                     requeue.append(seq)
             self.waiting.extendleft(reversed(requeue))
         else:
-            for i, (seq, toks) in enumerate(zip(batch.seqs, token_lists)):
+            for i, seq in enumerate(batch.seqs):
                 if seq.status.is_finished:
-                    continue  # aborted while the dispatch was in flight
+                    continue
+                seq.num_computed_tokens += batch.decode_steps[i]
+                seq.inflight_steps += batch.decode_steps[i]
+
+    def _apply_valid(self, seq: Sequence, epoch: int) -> bool:
+        """Results apply only to rows still in the generation that issued
+        them: finished (abort/stop) and preempted-since-issue rows discard
+        their in-flight tokens. (Non-final prefill rows are WAITING for
+        their next chunk — still valid; preemption is distinguished by the
+        epoch, not the queue.)"""
+        return (
+            not seq.status.is_finished
+            and seq.num_preemptions == epoch
+        )
+
+    def apply_results(
+        self, batch: ScheduledBatch, token_lists: List[List[int]],
+        logprob_lists=None,
+    ) -> tuple:
+        """Deliver a fetched dispatch's outputs (a token list per sequence;
+        empty for non-final prefill chunks; ``logprob_lists`` aligned
+        per-token entries when any row requested logprobs). Returns
+        (sequences that produced NEW tokens, number of tokens accepted).
+        State was already advanced by advance_at_issue."""
+        produced: List[Sequence] = []
+        accepted = 0
+        if batch.kind == "prefill":
+            for idx, seq in enumerate(batch.seqs):
+                if not self._apply_valid(seq, batch.epochs[idx]):
+                    continue
+                self._register_full_blocks(seq)
+                if batch.finals[idx] and token_lists[idx]:
+                    seq.inflight_steps -= 1
+                    self._append_token(
+                        seq, token_lists[idx][0],
+                        logprob_lists[idx][0]
+                        if logprob_lists and logprob_lists[idx] else None,
+                    )
+                    accepted += 1
+                    produced.append(seq)
+        else:
+            for i, (seq, toks) in enumerate(zip(batch.seqs, token_lists)):
+                if not self._apply_valid(seq, batch.epochs[i]):
+                    continue
+                seq.inflight_steps -= batch.decode_steps[i]
                 took = False
                 lps = logprob_lists[i] if logprob_lists else None
                 for j, tok in enumerate(toks):
                     if seq.status.is_finished:
                         break  # EOS/max_tokens hit mid-scan; rest discarded
-                    seq.num_computed_tokens += 1
-                    self._register_full_blocks(seq)
                     self._append_token(
                         seq, tok, lps[j] if lps else None
                     )
                     accepted += 1
                     took = True
+                self._register_full_blocks(seq)
                 if took:
                     produced.append(seq)
         for seq in produced:
             if seq.status.is_finished and seq in self.running:
                 self.running.remove(seq)
         return produced, accepted
+
+    def update_after_step(
+        self, batch: ScheduledBatch, token_lists: List[List[int]],
+        logprob_lists=None,
+    ) -> tuple:
+        """Synchronous advance+apply (non-pipelined callers and tests)."""
+        self.advance_at_issue(batch)
+        return self.apply_results(batch, token_lists, logprob_lists)
 
     def _append_token(self, seq: Sequence, token: int, logprob=None) -> None:
         if seq.first_token_time is None:
@@ -564,7 +632,10 @@ class Scheduler:
         if not seq.block_ids:
             return  # freed (abort/preempt) before this bookkeeping ran
         bs = self.config.block_size
-        full = seq.num_computed_tokens // bs
+        # num_computed_tokens may run ahead of the host-known token ids by
+        # the in-flight amount (pipelined issue); hashing needs the ids, so
+        # register only what the host has.
+        full = min(seq.num_computed_tokens, len(seq.all_token_ids)) // bs
         tokens = seq.all_token_ids
         while seq._num_hashed_blocks < full:
             i = seq._num_hashed_blocks

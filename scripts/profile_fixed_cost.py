@@ -1,0 +1,177 @@
+"""Decompose the serving dispatch wall time: per-step compute vs per-dispatch
+fixed cost, in isolation (no router/client processes competing for the one
+host core).
+
+Times the runner's REAL jitted dispatches at the bench's steady-state
+shapes: decode K in {1, 8, 32} with cached/fresh windows, the windowed
+continuation prefill, and gather_window alone. Prints one JSON line per
+measurement.
+
+Run: python scripts/profile_fixed_cost.py [--attn-impl window|paged]
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="llama-1b")
+    ap.add_argument("--ctx-tokens", type=int, default=1500)
+    ap.add_argument("--rows", type=int, default=16)
+    ap.add_argument("--reps", type=int, default=8)
+    ap.add_argument("--attn-impl", default="auto")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.runner import NUM_SCALARS, ModelRunner
+    from production_stack_tpu.models.config import resolve_model_config
+    from production_stack_tpu.ops.attention import gather_window
+    from production_stack_tpu.parallel.mesh import make_mesh
+    from production_stack_tpu.utils import window_mb_bucket
+
+    cfg = EngineConfig(
+        model=args.model, max_model_len=8192, block_size=16,
+        max_num_seqs=args.rows, max_num_batched_tokens=4096,
+        attn_impl=args.attn_impl,
+    )
+    mc = resolve_model_config(args.model)
+    runner = ModelRunner(cfg, mc, make_mesh(1, 1, 1))
+    bs = cfg.block_size
+    b = args.rows
+    blocks_per_row = -(-args.ctx_tokens // bs)
+    mb = runner._decode_mb(blocks_per_row)
+    print(json.dumps({"attn_impl": runner.attn_impl, "b": b, "mb": mb,
+                      "ctx": args.ctx_tokens,
+                      "num_kv_blocks": runner.num_kv_blocks}))
+    assert b * blocks_per_row < runner.num_kv_blocks - 1, "pool too small"
+
+    def packed_decode():
+        packed = np.zeros((NUM_SCALARS * b + b * mb,), np.int32)
+        sc = packed[: NUM_SCALARS * b].reshape(NUM_SCALARS, b)
+        bt = packed[NUM_SCALARS * b:].reshape(b, mb)
+        sc[0, :] = 1
+        sc[1, :] = args.ctx_tokens            # pos0
+        sc[2, :] = 10**6                      # budget: never exhausts
+        sc[6, :] = -1
+        sc[11, :] = -1  # no token chain
+        sc.view(np.float32)[7, :] = 1.0
+        for i in range(b):
+            bt[i, :blocks_per_row] = 1 + i * blocks_per_row + np.arange(
+                blocks_per_row, dtype=np.int32
+            )
+        return packed
+
+    win = None
+
+    def one_decode(k, cached):
+        nonlocal win
+        dummy = jnp.zeros((1, 1, 1, 1, 1), runner.dtype)
+        use_cached = bool(cached and win is not None)
+        out = runner._decode(
+            runner.params, jnp.asarray(packed_decode()),
+            runner.kv_k, runner.kv_v,
+            win[0] if use_cached else dummy,
+            win[1] if use_cached else dummy,
+            jnp.zeros((1, 1), jnp.int32), runner._zero_last,
+            b=b, mb=mb, num_steps=k, use_cached_window=use_cached,
+            has_penalties=False, logprobs_k=0,
+        )
+        toks, runner.kv_k, runner.kv_v = out[0], out[1], out[2]
+        if runner.attn_impl == "window":
+            win = (out[3], out[4])
+        else:
+            win = None
+        np.asarray(toks)  # the serving path's device->host sync
+
+    def time_decode(k, cached, label):
+        times = []
+        for rep in range(args.reps + 2):
+            t0 = time.monotonic()
+            one_decode(k, cached)
+            if rep >= 2:
+                times.append(time.monotonic() - t0)
+        ms = 1000 * float(np.median(times))
+        print(json.dumps({
+            "measure": label, "k": k, "ms": round(ms, 1),
+            "ms_per_step": round(ms / k, 2),
+            "tok_s_equiv": round(b * k / (ms / 1000)),
+        }))
+        return ms
+
+    time_decode(1, cached=False, label="decode_fresh_k1")
+    time_decode(32, cached=False, label="decode_fresh_k32")
+    cached = runner.attn_impl == "window"
+    m1 = time_decode(1, cached=cached, label="decode_steady_k1")
+    m8 = time_decode(8, cached=cached, label="decode_steady_k8")
+    m32 = time_decode(32, cached=cached, label="decode_steady_k32")
+    per_step = (m32 - m8) / 24
+    print(json.dumps({
+        "measure": "decode_decomposition",
+        "per_step_ms": round(per_step, 2),
+        "fixed_ms": round(m8 - 8 * per_step, 1),
+        "k1_ms": round(m1, 1),
+    }))
+
+    # gather_window alone (per fresh-batch window rebuild / windowed
+    # prefill gather).
+    bt = jnp.asarray(
+        packed_decode()[NUM_SCALARS * b:].reshape(b, mb)
+    )
+    g = jax.jit(lambda kk, vv, t: gather_window(kk, vv, t, bs))
+    for _ in range(3):
+        t0 = time.monotonic()
+        wk2, wv2 = g(runner.kv_k, runner.kv_v, bt)
+        jax.block_until_ready(wk2)
+        gw = time.monotonic() - t0
+    gbytes = 2 * wk2.size * wk2.dtype.itemsize / 1e9
+    print(json.dumps({"measure": "gather_window", "ms": round(1000 * gw, 1),
+                      "gbytes": round(gbytes, 2),
+                      "gb_s": round(gbytes / gw, 1)}))
+    del wk2, wv2
+
+    # Windowed continuation prefill at the bench's cache-hit round shape.
+    rows, t_chunk = 8, 256
+    pmb = window_mb_bucket(blocks_per_row, cfg.max_blocks_per_seq)
+    packed = np.zeros(
+        (NUM_SCALARS * rows + rows * pmb + rows * t_chunk,), np.int32
+    )
+    sc = packed[: NUM_SCALARS * rows].reshape(NUM_SCALARS, rows)
+    btp = packed[
+        NUM_SCALARS * rows: NUM_SCALARS * rows + rows * pmb
+    ].reshape(rows, pmb)
+    sc[0, :] = args.ctx_tokens
+    sc[1, :] = 120
+    sc[6, :] = -1
+    sc.view(np.float32)[7, :] = 1.0
+    for i in range(rows):
+        btp[i, :blocks_per_row] = 1 + i * blocks_per_row + np.arange(
+            blocks_per_row, dtype=np.int32
+        )
+    times = []
+    for rep in range(args.reps + 2):
+        t0 = time.monotonic()
+        out = runner._prefill(
+            runner.params, jnp.asarray(packed), runner.kv_k, runner.kv_v,
+            jnp.zeros((1, 1), jnp.int32),
+            b=rows, t=t_chunk, mb=pmb, has_window=True,
+            b_max=runner._b_max,
+            has_penalties=False, logprobs_k=0,
+        )
+        runner.kv_k, runner.kv_v = out[1], out[2]
+        np.asarray(out[0])
+        if rep >= 2:
+            times.append(time.monotonic() - t0)
+    print(json.dumps({"measure": "prefill_windowed", "rows": rows,
+                      "t": t_chunk, "mb": pmb,
+                      "ms": round(1000 * float(np.median(times)), 1)}))
+
+
+if __name__ == "__main__":
+    main()
