@@ -61,14 +61,15 @@ class EngineConfig:
     #             raises if the model/block size can't satisfy the kernel's
     #             alignment constraints.
     attn_impl: str = "auto"
-    # Fused-decode loop construct: "while" runs exactly the steps some row
-    # still needs (lax.while_loop; drain tails skip padded iterations) and
-    # is the measured-faster default on v5e; "scan" runs all K steps
-    # unconditionally (lax.scan — XLA can pipeline/unroll it more
-    # aggressively). Kept as a first-class A/B knob because the tradeoff is
-    # workload-dependent (VERDICT r4 weak #2 demanded the comparison be
-    # runnable, not asserted).
-    decode_loop: str = "while"
+    # Fused-decode loop construct: "scan" runs all K steps unconditionally
+    # (lax.scan — XLA pipelines/unrolls it aggressively); "while" runs
+    # exactly the steps some row still needs (lax.while_loop; drain tails
+    # skip padded iterations). A/B on the v5e bench (pipelined loop, r5):
+    # scan 1743 tok/s vs while 1651 — with the per-dispatch sync hidden,
+    # scan's compiler latitude beats the drain-tail savings, so scan is
+    # the default; while remains for latency-odd workloads with many
+    # short-budget rows.
+    decode_loop: str = "scan"
     # Pipelined engine loop: issue dispatch N+1 before fetching N's tokens
     # (device-chained start tokens; scheduler state advanced speculatively
     # at issue). Hides the blocking device->host sync — ~100 ms of tunnel
