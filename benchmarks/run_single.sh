@@ -1,0 +1,65 @@
+#!/bin/bash
+# Single-backend QPS sweep (reference benchmarks/multi-round-qa/run_single.sh):
+# the one-engine variant of run.sh, for A/B-ing engine or router knobs —
+# e.g. the resilience settings in docs/RESILIENCE.md — against a single
+# backend without multi-pod routing noise.
+#
+# Usage: ./run_single.sh <model> <base url> <save file key> [launch]
+#   model          served model name (e.g. llama-1b)
+#   base url       engine or router URL (e.g. http://localhost:8000)
+#   save file key  output prefix: {key}_output_{qps}.csv per QPS point
+#   launch         pass "launch" to bring up a one-engine stack locally
+#                  first (benchmarks/stack.py) and sweep against it
+#
+# Afterwards: python3 benchmarks/plot.py to draw the TTFT-vs-QPS curve.
+set -e
+
+if [[ $# -lt 3 ]]; then
+    echo "Usage: $0 <model> <base url> <save file key> [launch]"
+    exit 1
+fi
+
+MODEL=$1
+BASE_URL=$2
+KEY=$3
+REPO_ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO_ROOT"
+
+if [[ "${4:-}" == "launch" ]]; then
+    eval "$(python3 - "$MODEL" <<'EOF'
+import sys
+from benchmarks.stack import launch_stack
+stack = launch_stack(sys.argv[1])
+print(f"BASE_URL={stack.router_url}")
+print(f"STACK_PIDS='{stack.engine.pid} {stack.router.pid}'")
+EOF
+)"
+    trap 'kill $STACK_PIDS 2>/dev/null || true' EXIT
+    echo "Launched single-engine stack at $BASE_URL"
+fi
+
+# Workload shape: run.sh scaled to one engine (override via env).
+NUM_USERS=${NUM_USERS:-64}
+NUM_ROUNDS=${NUM_ROUNDS:-10}
+SYSTEM_PROMPT_WORDS=${SYSTEM_PROMPT_WORDS:-150}   # ~1000 tok system prompt
+ANSWER_LEN=${ANSWER_LEN:-100}
+TIME_LIMIT=${TIME_LIMIT:-100}
+QPS_VALUES=(${QPS_VALUES:-0.5 1 2 4})
+
+# Prime compiled shape families + prefix cache first (warmup_single.sh).
+"$REPO_ROOT/benchmarks/warmup_single.sh" "$MODEL" "$BASE_URL"
+
+for qps in "${QPS_VALUES[@]}"; do
+    output_file="${KEY}_output_${qps}.csv"
+    echo "Running single-backend sweep: qps=$qps -> $output_file"
+    python3 -m benchmarks.multi_round_qa \
+        --num-users "$NUM_USERS" \
+        --num-rounds "$NUM_ROUNDS" \
+        --qps "$qps" \
+        --system-prompt-words "$SYSTEM_PROMPT_WORDS" \
+        --answer-tokens "$ANSWER_LEN" \
+        --model "$MODEL" \
+        --base-url "$BASE_URL" \
+        --output "$output_file" \
+        --time "$TIME_LIMIT"
+done

@@ -182,6 +182,10 @@ class ServingEngine:
             self._loop_task is not None and not self._loop_task.done()
         )
 
+    def active_request_ids(self) -> List[str]:
+        """Request ids with a live output stream (drain/abort bookkeeping)."""
+        return list(self._streams)
+
     # ----------------------------------------------------------------- intake
     async def generate(
         self,

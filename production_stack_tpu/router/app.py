@@ -38,11 +38,16 @@ from production_stack_tpu.router.files_service import initialize_storage
 from production_stack_tpu.router.request_service import (
     _error,
     proxy_request,
+    resilient_json_request,
     route_general_request,
+)
+from production_stack_tpu.router.resilience import (
+    ResilienceConfig,
+    get_resilience,
+    initialize_resilience,
 )
 from production_stack_tpu.router.rewriter import get_request_rewriter
 from production_stack_tpu.router.routing_logic import (
-    get_routing_logic,
     initialize_routing_logic,
 )
 from production_stack_tpu.router.service_discovery import (
@@ -119,6 +124,9 @@ async def handle_health(request: web.Request) -> web.Response:
         return web.json_response({"status": "unhealthy",
                                   "problems": problems}, status=503)
     payload = {"status": "healthy"}
+    resilience = get_resilience()
+    if resilience is not None:
+        payload["circuit_breakers"] = resilience.snapshot()
     watcher = get_dynamic_config_watcher()
     if watcher is not None:
         payload["dynamic_config"] = watcher.get_current_config()
@@ -265,6 +273,19 @@ def initialize_all(app: web.Application, args) -> None:
         args.routing_logic, session_key=args.session_key,
         block_reuse_timeout=args.block_reuse_timeout,
     )
+    # getattr defaults keep pre-resilience arg namespaces (operator-rendered
+    # configs, test fixtures) working.
+    initialize_resilience(ResilienceConfig(
+        retry_max_attempts=getattr(args, "retry_max_attempts", 3),
+        retry_backoff_base=getattr(args, "retry_backoff_base", 0.05),
+        retry_backoff_cap=getattr(args, "retry_backoff_cap", 1.0),
+        breaker_window=getattr(args, "breaker_window", 30.0),
+        breaker_min_requests=getattr(args, "breaker_min_requests", 5),
+        breaker_error_rate=getattr(args, "breaker_error_rate", 0.5),
+        breaker_open_duration=getattr(args, "breaker_open_duration", 10.0),
+        default_timeout=getattr(args, "request_timeout", 300.0),
+        default_ttft_deadline=getattr(args, "ttft_deadline", 0.0),
+    ))
     gates = initialize_feature_gates(args.feature_gates)
 
     if gates.enabled(SEMANTIC_CACHE):
@@ -318,30 +339,13 @@ def initialize_all(app: web.Application, args) -> None:
 
 async def _inprocess_request(app: web.Application, endpoint: str,
                              body: dict) -> dict:
-    """Run one request through routing + backend for the batch processor."""
-    import json as _json
+    """Run one request through routing + backend for the batch processor.
 
-    from production_stack_tpu.router.request_service import RoutedRequest
-    from production_stack_tpu.router.stats import (
-        get_engine_stats_scraper as scraper,
-        get_request_stats_monitor as monitor,
-    )
-
-    model = body.get("model")
-    endpoints = [
-        ep for ep in get_service_discovery().get_endpoint_info()
-        if not ep.model_names or model in ep.model_names
-    ]
-    if not endpoints:
-        raise RuntimeError(f"No backend serves model {model!r}")
-    url = get_routing_logic().route_request(
-        endpoints, scraper().get_engine_stats(),
-        monitor().get_request_stats(time.time()),
-        RoutedRequest({}, body),
-    )
-    session = app["client_session"]
-    async with session.post(f"{url}{endpoint}", json=body) as resp:
-        return _json.loads(await resp.read())
+    Routed through the resilience wrapper so batch jobs survive a backend
+    restart (retry + failover + circuit breaking) instead of failing the
+    whole line on the first aiohttp error.
+    """
+    return await resilient_json_request(app, endpoint, body)
 
 
 def build_app(args) -> web.Application:

@@ -79,12 +79,17 @@ class FileStorage(Storage):
             id=file_id, bytes=len(content), created_at=int(time.time()),
             filename=filename, purpose=purpose,
         )
-        import aiofiles
+        def _write() -> None:
+            with open(self._data_path(file_id), "wb") as f:
+                f.write(content)
+            with open(self._meta_path(file_id), "w") as f:
+                f.write(json.dumps(info.to_dict()))
 
-        async with aiofiles.open(self._data_path(file_id), "wb") as f:
-            await f.write(content)
-        async with aiofiles.open(self._meta_path(file_id), "w") as f:
-            await f.write(json.dumps(info.to_dict()))
+        # Plain file I/O in the default executor: aiofiles is not in this
+        # image, and it would only do the same thing on its own thread pool.
+        import asyncio
+
+        await asyncio.get_running_loop().run_in_executor(None, _write)
         self._index[file_id] = info
         return info
 
@@ -96,10 +101,13 @@ class FileStorage(Storage):
 
     async def get_file_content(self, file_id: str) -> bytes:
         await self.get_file(file_id)
-        import aiofiles
+        import asyncio
 
-        async with aiofiles.open(self._data_path(file_id), "rb") as f:
-            return await f.read()
+        def _read() -> bytes:
+            with open(self._data_path(file_id), "rb") as f:
+                return f.read()
+
+        return await asyncio.get_running_loop().run_in_executor(None, _read)
 
     async def list_files(self) -> List[OpenAIFile]:
         return list(self._index.values())

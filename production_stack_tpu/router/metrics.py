@@ -7,7 +7,7 @@ reference dashboard charts but never emits (SURVEY.md §5 observability):
 here they are actually emitted.
 """
 
-from prometheus_client import Gauge, Histogram
+from prometheus_client import Counter, Gauge, Histogram
 
 num_requests_running = Gauge(
     "vllm:num_requests_running",
@@ -73,4 +73,24 @@ router_e2e_latency_seconds = Histogram(
 )
 avg_prefill_length = Gauge(
     "vllm:avg_prefill_length", "Average prompt length per engine", ["server"],
+)
+# Data-plane resilience series (router/resilience.py). ``server`` is the
+# backend the event was observed against.
+router_retries_total = Counter(
+    "router_retries",
+    "Pre-stream backend failures that triggered a retry", ["server"],
+)
+router_failovers_total = Counter(
+    "router_failovers",
+    "Retries that moved the request away from this backend", ["server"],
+)
+router_circuit_state = Gauge(
+    "router_circuit_state",
+    "Circuit breaker state per backend (0=closed, 1=open, 2=half-open)",
+    ["server"],
+)
+router_deadline_exceeded_total = Counter(
+    "router_deadline_exceeded",
+    "Requests aborted on a deadline (kind: ttft or total)",
+    ["server", "kind"],
 )

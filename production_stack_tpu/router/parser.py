@@ -58,6 +58,31 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--request-rewriter", default="noop")
     p.add_argument("--callbacks", default="",
                    help="dotted path to a callbacks instance")
+
+    # Data-plane resilience knobs (docs/RESILIENCE.md has the full table).
+    p.add_argument("--retry-max-attempts", type=int, default=3,
+                   help="total backend attempts per request (1 = no retry)")
+    p.add_argument("--retry-backoff-base", type=float, default=0.05,
+                   help="first retry delay in seconds (doubles per retry, "
+                        "full jitter)")
+    p.add_argument("--retry-backoff-cap", type=float, default=1.0,
+                   help="per-retry delay ceiling in seconds")
+    p.add_argument("--breaker-window", type=float, default=30.0,
+                   help="rolling outcome window for the circuit breaker")
+    p.add_argument("--breaker-min-requests", type=int, default=5,
+                   help="outcomes required in the window before tripping")
+    p.add_argument("--breaker-error-rate", type=float, default=0.5,
+                   help="windowed error rate that opens a backend's circuit")
+    p.add_argument("--breaker-open-duration", type=float, default=10.0,
+                   help="seconds an open circuit waits before the half-open "
+                        "probe")
+    p.add_argument("--request-timeout", type=float, default=300.0,
+                   help="default total per-request deadline in seconds "
+                        "(0 disables; x-request-timeout header overrides)")
+    p.add_argument("--ttft-deadline", type=float, default=0.0,
+                   help="default deadline to the first backend byte in "
+                        "seconds (0 disables; x-ttft-deadline header "
+                        "overrides)")
     args = p.parse_args(argv)
     validate_args(args)
     return args
@@ -73,6 +98,10 @@ def validate_args(args: argparse.Namespace) -> None:
             raise ValueError(
                 "--static-models required with --service-discovery static"
             )
+    if getattr(args, "retry_max_attempts", 1) < 1:
+        raise ValueError("--retry-max-attempts must be >= 1")
+    if not 0 < getattr(args, "breaker_error_rate", 0.5) <= 1:
+        raise ValueError("--breaker-error-rate must be in (0, 1]")
     if args.routing_logic in ("session", "cache_aware_load_balancing") \
             and not args.session_key:
         # cache_aware without a session key would silently degrade to pure

@@ -1,4 +1,4 @@
-"""Request proxy: model filtering, routing, streaming relay, stats hooks.
+"""Request proxy: model filtering, routing, resilient streaming relay.
 
 Contract parity with reference src/vllm_router/services/request_service/request.py:
   * ``route_general_request`` — body parse, callbacks.pre_request
@@ -9,17 +9,39 @@ Contract parity with reference src/vllm_router/services/request_service/request.
     hooks and semantic-cache store + callbacks.post_request on completion
     (:58-141).
 
+On top of the reference contract the proxy path is wrapped in the
+resilience layer (router/resilience.py):
+  * pre-stream failures (connect refused/timed out, 502/503 from the
+    backend) are retried with capped exponential backoff + jitter and
+    failed over to the next-best backend from the routing policy, skipping
+    open-circuit and already-tried backends;
+  * per-request TTFT/total deadlines abort the backend call with a clean
+    504 (``x-ttft-deadline`` / ``x-request-timeout`` header overrides);
+  * mid-stream failures stay truncation-only (bytes are never resent) but
+    mark the backend so its circuit can open.
+
 Built on aiohttp client streams instead of httpx (not in this image); the
 response is relayed chunk-by-chunk so SSE token streaming works end-to-end.
 """
 
+import asyncio
 import json
 import time
 from typing import Optional
 
+import aiohttp
 from aiohttp import web
 
 from production_stack_tpu.router import metrics
+from production_stack_tpu.router.resilience import (
+    RETRYABLE_STATUSES,
+    Deadline,
+    DeadlineExceeded,
+    PreStreamFailure,
+    ResilienceConfig,
+    backoff_delay,
+    get_resilience,
+)
 from production_stack_tpu.router.routing_logic import get_routing_logic
 from production_stack_tpu.router.service_discovery import get_service_discovery
 from production_stack_tpu.router.stats import (
@@ -32,6 +54,18 @@ from production_stack_tpu.utils import init_logger
 
 logger = init_logger(__name__)
 
+#: Errors that mean the backend never delivered a usable response before
+#: any byte reached the client — retry/failover is safe. ClientError covers
+#: refused/reset/disconnected connections, malformed payloads, and aiohttp
+#: socket timeouts; OSError covers raw socket failures (and ConnectionError).
+_CONNECT_ERRORS = (aiohttp.ClientError, OSError)
+
+
+class _MidStreamBackendError(Exception):
+    """Marker: the BACKEND stream failed after bytes reached the client
+    (distinguishes backend read errors, which mark the breaker, from
+    client-side write errors, which must not)."""
+
 
 class RoutedRequest:
     """Duck-typed view handed to RoutingInterface implementations."""
@@ -41,17 +75,47 @@ class RoutedRequest:
         self.json_body = json_body
 
 
-def _error(status: int, message: str, etype: str = "invalid_request_error"):
+def _error(status: int, message: str, etype: str = "invalid_request_error",
+           headers: Optional[dict] = None):
     return web.json_response(
         ErrorResponse(message=message, type=etype, code=status).to_dict(),
-        status=status,
+        status=status, headers=headers,
+    )
+
+
+def _resilience_config() -> ResilienceConfig:
+    mgr = get_resilience()
+    return mgr.config if mgr is not None else ResilienceConfig()
+
+
+def _next_backend(endpoints, tried, resilience, request_like) -> Optional[str]:
+    """Pick the next backend from the routing policy, excluding already-tried
+    and open-circuit backends. When every candidate has been tried already
+    (single-backend restart case), previously tried backends become eligible
+    again — the breaker still gates them."""
+    def _allowed(pool):
+        return [
+            ep for ep in pool
+            if resilience is None or resilience.allow(ep.url)
+        ]
+
+    candidates = _allowed([ep for ep in endpoints if ep.url not in tried])
+    if not candidates:
+        candidates = _allowed(endpoints)
+    if not candidates:
+        return None
+    engine_stats = get_engine_stats_scraper().get_engine_stats()
+    request_stats = get_request_stats_monitor().get_request_stats(time.time())
+    return get_routing_logic().route_request(
+        candidates, engine_stats, request_stats, request_like
     )
 
 
 async def route_general_request(
     request: web.Request, endpoint: str
 ) -> web.StreamResponse:
-    """Proxy `request` to the backend chosen by the routing logic."""
+    """Proxy `request` to the backend chosen by the routing logic, with
+    retry/failover on pre-stream failures and per-request deadlines."""
     app = request.app
     in_time = time.time()
     try:
@@ -87,38 +151,85 @@ async def route_general_request(
             etype="model_not_found",
         )
 
-    engine_stats = get_engine_stats_scraper().get_engine_stats()
-    request_stats = get_request_stats_monitor().get_request_stats(time.time())
-    router = get_routing_logic()
-    backend_url = router.route_request(
-        endpoints, engine_stats, request_stats,
-        RoutedRequest(request.headers, body),
-    )
-    route_time = time.time()
-    metrics.router_queueing_delay_seconds.labels(server=backend_url).set(
-        route_time - in_time
-    )
-    logger.debug("Routing request %s for model %s to %s (%.1f ms)",
-                 request_id, model, backend_url, (route_time - in_time) * 1e3)
-    # One span per routed request (when tracing is enabled); its context
-    # propagates to the engine via the W3C traceparent header (reference
-    # tutorials/12-distributed-tracing.md).
+    resilience = get_resilience()
+    cfg = _resilience_config()
+    deadline = Deadline.from_request(request.headers, cfg)
+    routed = RoutedRequest(request.headers, body)
+    payload = json.dumps(body).encode()
+    tried: set = set()
+    attempt = 0
+    last_failure: Optional[PreStreamFailure] = None
+
     import contextlib
 
     tracer = get_tracer("pstpu-router")
-    span_cm = contextlib.nullcontext() if tracer is None else tracer.span(
-        f"router.route {endpoint}",
-        parent=request.headers.get("traceparent"),
-        attributes={"backend": backend_url, "model": model,
-                    "request.id": request_id,
-                    "queueing.delay_ms": (route_time - in_time) * 1e3},
-    )
-    with span_cm as span:
-        return await proxy_request(
-            request, backend_url, endpoint, json.dumps(body).encode(),
-            request_id, body=body,
-            traceparent=span.traceparent if span else None,
+
+    while attempt < max(1, cfg.retry_max_attempts):
+        attempt += 1
+        backend_url = _next_backend(endpoints, tried, resilience, routed)
+        if backend_url is None:
+            return _error(
+                503, "All backends unavailable (circuit open)",
+                etype="service_unavailable", headers={"Retry-After": "1"},
+            )
+        if last_failure is not None and backend_url != last_failure.backend_url:
+            metrics.router_failovers_total.labels(
+                server=last_failure.backend_url).inc()
+        tried.add(backend_url)
+        route_time = time.time()
+        metrics.router_queueing_delay_seconds.labels(server=backend_url).set(
+            route_time - in_time
         )
+        logger.debug("Routing request %s for model %s to %s (%.1f ms, try %d)",
+                     request_id, model, backend_url,
+                     (route_time - in_time) * 1e3, attempt)
+        # One span per routed attempt (when tracing is enabled); its context
+        # propagates to the engine via the W3C traceparent header (reference
+        # tutorials/12-distributed-tracing.md).
+        span_cm = contextlib.nullcontext() if tracer is None else tracer.span(
+            f"router.route {endpoint}",
+            parent=request.headers.get("traceparent"),
+            attributes={"backend": backend_url, "model": model,
+                        "request.id": request_id, "attempt": attempt,
+                        "queueing.delay_ms": (route_time - in_time) * 1e3},
+        )
+        try:
+            with span_cm as span:
+                return await proxy_request(
+                    request, backend_url, endpoint, payload,
+                    request_id, body=body, deadline=deadline,
+                    traceparent=span.traceparent if span else None,
+                )
+        except DeadlineExceeded as e:
+            metrics.router_deadline_exceeded_total.labels(
+                server=e.backend_url, kind=e.kind
+            ).inc()
+            return _error(
+                504, f"Request {e.kind} deadline exceeded",
+                etype="deadline_exceeded",
+            )
+        except PreStreamFailure as e:
+            last_failure = e
+            if attempt >= max(1, cfg.retry_max_attempts):
+                break
+            metrics.router_retries_total.labels(server=e.backend_url).inc()
+            delay = backoff_delay(attempt, cfg)
+            rem = deadline.remaining_total()
+            if rem is not None and rem <= delay:
+                metrics.router_deadline_exceeded_total.labels(
+                    server=e.backend_url, kind="total"
+                ).inc()
+                return _error(
+                    504, "Request total deadline exceeded",
+                    etype="deadline_exceeded",
+                )
+            await asyncio.sleep(delay)
+
+    return _error(
+        502, f"Backend request failed after {attempt} attempt(s): "
+             f"{last_failure}",
+        etype="bad_gateway",
+    )
 
 
 async def proxy_request(
@@ -129,67 +240,222 @@ async def proxy_request(
     request_id: str,
     body: Optional[dict] = None,
     traceparent: Optional[str] = None,
+    deadline: Optional[Deadline] = None,
 ) -> web.StreamResponse:
-    """Stream the backend response through to the client."""
+    """Stream the backend response through to the client.
+
+    Raises PreStreamFailure (retryable) or DeadlineExceeded while nothing
+    has been sent to the client; once bytes are on the wire failures
+    truncate the stream and mark the backend instead.
+    """
     app = request.app
     session = app["client_session"]
+    resilience = get_resilience()
+    if resilience is not None:
+        resilience.on_dispatch(backend_url)
     monitor = get_request_stats_monitor()
     monitor.on_new_request(backend_url, request_id, time.time())
 
-    headers = {"Content-Type": "application/json"}
+    # Forward the client's request id so router and engine logs/traces
+    # correlate (it is also echoed back to the client below).
+    headers = {"Content-Type": "application/json",
+               "x-request-id": request_id}
     auth = request.headers.get("Authorization")
     if auth:
         headers["Authorization"] = auth
     if traceparent:
         headers["traceparent"] = traceparent
 
-    response: Optional[web.StreamResponse] = None
-    try:
-        async with session.post(
-            f"{backend_url}{endpoint}", data=payload, headers=headers
-        ) as backend_resp:
-            response = web.StreamResponse(
-                status=backend_resp.status,
-                headers={
-                    "Content-Type": backend_resp.headers.get(
-                        "Content-Type", "application/json"
-                    ),
-                    "x-request-id": request_id,
-                },
-            )
-            await response.prepare(request)
-            first = True
-            full_chunks = []
-            # Only non-streamed responses are cacheable; buffering SSE bodies
-            # the cache would discard anyway just burns memory.
-            cacheable = (
-                app.get("semantic_cache") is not None
-                and body is not None and not body.get("stream")
-            )
-            async for chunk in backend_resp.content.iter_any():
-                now = time.time()
-                if first:
-                    monitor.on_request_response(backend_url, request_id, now)
-                    first = False
-                else:
-                    monitor.on_request_token(backend_url, request_id, now)
-                if cacheable:
-                    full_chunks.append(chunk)
-                await response.write(chunk)
-            monitor.on_request_complete(backend_url, request_id, time.time())
-            await response.write_eof()
-    except Exception as e:  # noqa: BLE001 — backend connect/stream failure
+    def _fail(reason: str, status: Optional[int] = None) -> PreStreamFailure:
         monitor.on_request_complete(backend_url, request_id, time.time())
-        logger.warning("Proxy to %s failed: %s", backend_url, e)
-        if response is None or not response.prepared:
-            # Nothing sent yet: a clean 502 is still possible.
-            return _error(
-                502, f"Backend request failed: {e}", etype="bad_gateway"
+        if resilience is not None:
+            resilience.record_failure(backend_url)
+        logger.warning("Proxy to %s failed pre-stream: %s", backend_url, reason)
+        return PreStreamFailure(backend_url, reason, status=status)
+
+    def _deadline(kind: str) -> DeadlineExceeded:
+        monitor.on_request_complete(backend_url, request_id, time.time())
+        logger.warning("Request %s %s deadline exceeded at %s",
+                       request_id, kind, backend_url)
+        return DeadlineExceeded(kind, backend_url)
+
+    rem_ttft = deadline.remaining_ttft() if deadline is not None else None
+    if rem_ttft is not None and rem_ttft <= 0:
+        raise _deadline(deadline.binding_kind())
+
+    backend_resp = None
+    try:
+        try:
+            post = session.post(
+                f"{backend_url}{endpoint}", data=payload, headers=headers
             )
+            backend_resp = (
+                await asyncio.wait_for(post, rem_ttft)
+                if rem_ttft is not None else await post
+            )
+        except aiohttp.ServerTimeoutError as e:
+            # aiohttp's own socket timeout (subclasses asyncio.TimeoutError,
+            # so it must be caught before the wait_for deadline below).
+            raise _fail(f"connect timed out: {e!r}") from e
+        except asyncio.TimeoutError:
+            if deadline is None:    # aiohttp-internal timeout, no deadline
+                raise _fail("connect timed out") from None
+            raise _deadline(deadline.binding_kind()) from None
+        except _CONNECT_ERRORS as e:
+            raise _fail(f"connect failed: {e!r}") from e
+
+        if backend_resp.status in RETRYABLE_STATUSES:
+            backend_resp.close()
+            raise _fail(f"backend returned {backend_resp.status}",
+                        status=backend_resp.status)
+
+        # First chunk BEFORE preparing the client response: a TTFT-deadline
+        # abort or a disconnect here can still become a clean 504/retry.
+        chunks_iter = backend_resp.content.iter_any()
+        rem_ttft = deadline.remaining_ttft() if deadline is not None else None
+        first_chunk: Optional[bytes] = None
+        try:
+            get_first = chunks_iter.__anext__()
+            first_chunk = (
+                await asyncio.wait_for(get_first, rem_ttft)
+                if rem_ttft is not None else await get_first
+            )
+        except StopAsyncIteration:
+            first_chunk = None
+        except aiohttp.ServerTimeoutError as e:
+            backend_resp.close()
+            raise _fail(f"read timed out before first byte: {e!r}") from e
+        except asyncio.TimeoutError:
+            backend_resp.close()
+            if deadline is None:    # aiohttp-internal timeout, no deadline
+                raise _fail("read timed out before first byte") from None
+            raise _deadline(deadline.binding_kind()) from None
+        except _CONNECT_ERRORS as e:
+            backend_resp.close()
+            raise _fail(f"stream failed before first byte: {e!r}") from e
+    except (PreStreamFailure, DeadlineExceeded):
+        if backend_resp is not None and not backend_resp.closed:
+            backend_resp.close()
+        raise
+    except asyncio.CancelledError:
+        if backend_resp is not None and not backend_resp.closed:
+            backend_resp.close()
+        raise
+    except Exception as e:  # noqa: BLE001 — anything unanticipated pre-stream
+        # e.g. RuntimeError("Session is closed") during router shutdown:
+        # nothing reached the client yet, so it is still a clean, retryable
+        # pre-stream failure (and the stats entry must be closed out).
+        if backend_resp is not None and not backend_resp.closed:
+            backend_resp.close()
+        raise _fail(f"unexpected pre-stream failure: {e!r}") from e
+
+    # From here on, bytes go to the client: failures are truncation-only.
+    response = web.StreamResponse(
+        status=backend_resp.status,
+        headers={
+            "Content-Type": backend_resp.headers.get(
+                "Content-Type", "application/json"
+            ),
+            "x-request-id": request_id,
+        },
+    )
+    completed = False   # guards double on_request_complete if write_eof fails
+    try:
+        await response.prepare(request)
+        full_chunks = []
+        # Only non-streamed responses are cacheable; buffering SSE bodies
+        # the cache would discard anyway just burns memory.
+        cacheable = (
+            app.get("semantic_cache") is not None
+            and body is not None and not body.get("stream")
+        )
+        first = True
+        chunk = first_chunk
+        while chunk is not None:
+            now = time.time()
+            if first:
+                monitor.on_request_response(backend_url, request_id, now)
+                first = False
+            else:
+                monitor.on_request_token(backend_url, request_id, now)
+            if cacheable:
+                full_chunks.append(chunk)
+            rem = deadline.remaining_total() if deadline is not None else None
+            # The write is also deadline-bounded: a client that stops
+            # reading must not hold the request (and its backend
+            # connection) open past x-request-timeout.
+            if rem is not None:
+                await asyncio.wait_for(response.write(chunk), rem)
+            else:
+                await response.write(chunk)
+            rem = deadline.remaining_total() if deadline is not None else None
+            try:
+                get_next = chunks_iter.__anext__()
+                chunk = (
+                    await asyncio.wait_for(get_next, rem)
+                    if rem is not None else await get_next
+                )
+            except StopAsyncIteration:
+                chunk = None
+            except aiohttp.ServerTimeoutError as e:
+                # aiohttp socket timeout, not our deadline: backend failure
+                # (the outer handler truncates and marks the backend).
+                raise _MidStreamBackendError(e) from e
+            except asyncio.TimeoutError:
+                # Mid-stream deadline: truncate; never resend bytes.
+                metrics.router_deadline_exceeded_total.labels(
+                    server=backend_url, kind="total"
+                ).inc()
+                logger.warning("Request %s total deadline exceeded "
+                               "mid-stream at %s", request_id, backend_url)
+                backend_resp.close()
+                monitor.on_request_complete(backend_url, request_id,
+                                            time.time())
+                completed = True
+                await response.write_eof()
+                return response
+            except Exception as e:  # noqa: BLE001 — backend read failure
+                raise _MidStreamBackendError(e) from e
+        monitor.on_request_complete(backend_url, request_id, time.time())
+        completed = True
+        await response.write_eof()
+    except _MidStreamBackendError as e:
+        if not completed:
+            monitor.on_request_complete(backend_url, request_id, time.time())
+        if resilience is not None:
+            resilience.record_failure(backend_url)
+        logger.warning("Proxy to %s failed mid-stream: %s",
+                       backend_url, e.__cause__)
+        backend_resp.close()
         # Headers/body already on the wire: abort the stream so the client
         # sees truncation instead of a corrupted second response.
-        await response.write_eof()
+        try:
+            await response.write_eof()
+        except (ConnectionResetError, RuntimeError):
+            pass
         return response
+    except Exception as e:  # noqa: BLE001 — CLIENT-side write failure
+        # The client went away (or stalled past the deadline) mid-relay:
+        # not the backend's fault, so the breaker is NOT marked.
+        if not completed:
+            monitor.on_request_complete(backend_url, request_id, time.time())
+        if isinstance(e, asyncio.TimeoutError):
+            metrics.router_deadline_exceeded_total.labels(
+                server=backend_url, kind="total"
+            ).inc()
+        logger.info("Client for request %s dropped mid-stream (%s)",
+                    request_id, e)
+        backend_resp.close()
+        return response
+
+    if resilience is not None:
+        # Relayed error responses are not breaker successes: a backend
+        # stuck returning 500s must still trip its circuit eventually.
+        if backend_resp.status >= 500:
+            resilience.record_failure(backend_url)
+        else:
+            resilience.record_success(backend_url)
+    backend_resp.release()
 
     cache = app.get("semantic_cache")
     if cache is not None and cacheable and backend_resp.status == 200:
@@ -201,3 +467,84 @@ async def proxy_request(
     if callbacks is not None:
         await callbacks.post_request(request, body)
     return response
+
+
+async def resilient_json_request(
+    app, endpoint: str, body: dict, headers: Optional[dict] = None,
+) -> dict:
+    """One non-streaming request through routing + resilience, for callers
+    without an inbound web.Request (the batch processor). Retries/fails over
+    on connect errors and 502/503 like the proxy path; raises RuntimeError
+    once the retry budget is exhausted.
+
+    NOTE: keep breaker/metric semantics in sync with route_general_request /
+    proxy_request above (same attempt loop over a different transport)."""
+    import os
+
+    model = body.get("model")
+    endpoints = [
+        ep for ep in get_service_discovery().get_endpoint_info()
+        if not ep.model_names or model in ep.model_names
+    ]
+    if not endpoints:
+        raise RuntimeError(f"No backend serves model {model!r}")
+    resilience = get_resilience()
+    cfg = _resilience_config()
+    session = app["client_session"]
+    routed = RoutedRequest(headers or {}, body)
+    # Forward auth + correlation id to the backend. Engines behind
+    # --api-key accept the shared VLLM_API_KEY (the discovery probe's
+    # convention) when the caller supplies no Authorization of its own.
+    fwd_headers = {}
+    for name in ("Authorization", "x-request-id"):
+        val = (headers or {}).get(name) or (headers or {}).get(name.lower())
+        if val:
+            fwd_headers[name] = val
+    if "Authorization" not in fwd_headers and os.environ.get("VLLM_API_KEY"):
+        fwd_headers["Authorization"] = f"Bearer {os.environ['VLLM_API_KEY']}"
+    tried: set = set()
+    attempt = 0
+    last_error: Optional[Exception] = None
+    last_failed_url: Optional[str] = None
+    while attempt < max(1, cfg.retry_max_attempts):
+        attempt += 1
+        url = _next_backend(endpoints, tried, resilience, routed)
+        if url is None:
+            raise RuntimeError("All backends unavailable (circuit open)")
+        if last_failed_url is not None and url != last_failed_url:
+            metrics.router_failovers_total.labels(
+                server=last_failed_url).inc()
+        tried.add(url)
+        if resilience is not None:
+            resilience.on_dispatch(url)
+        try:
+            async with session.post(
+                f"{url}{endpoint}", json=body, headers=fwd_headers
+            ) as resp:
+                if resp.status in RETRYABLE_STATUSES:
+                    raise PreStreamFailure(
+                        url, f"backend returned {resp.status}",
+                        status=resp.status,
+                    )
+                status = resp.status
+                data = await resp.read()
+            if resilience is not None:
+                # Same breaker semantics as the proxy path: relayed 5xx
+                # (e.g. a wedged backend's 500s) are failures, not successes.
+                if status >= 500:
+                    resilience.record_failure(url)
+                else:
+                    resilience.record_success(url)
+            return json.loads(data)
+        except (PreStreamFailure, *_CONNECT_ERRORS) as e:
+            last_error = e
+            last_failed_url = url
+            if resilience is not None:
+                resilience.record_failure(url)
+            logger.warning("Batch request to %s failed: %s", url, e)
+            if attempt < max(1, cfg.retry_max_attempts):
+                metrics.router_retries_total.labels(server=url).inc()
+                await asyncio.sleep(backoff_delay(attempt, cfg))
+    raise RuntimeError(
+        f"Backend request failed after {attempt} attempt(s): {last_error}"
+    )

@@ -1,0 +1,285 @@
+"""Data-plane resilience: per-backend circuit breakers, retry/failover
+budgets, and per-request deadlines.
+
+The router's elasticity story (PAPER.md §1, §5) is readiness-gated
+discovery — but the K8s watch notices a dead pod seconds after the first
+connect refused. This module covers that gap at request time:
+
+  * ``CircuitBreaker`` — rolling error-rate state machine per backend:
+    CLOSED (serving) → OPEN (ejected after the windowed error rate crosses
+    the threshold) → HALF_OPEN (after a cooldown, exactly one probe request
+    is let through; success closes the circuit, failure re-opens it).
+  * ``ResilienceManager`` — the per-backend breaker registry the proxy path
+    consults before routing and reports outcomes to; exports
+    ``router_circuit_state`` and is surfaced in the router's /health.
+  * ``Deadline`` — per-request TTFT + total budgets, defaulted from router
+    flags and overridable per request via the ``x-ttft-deadline`` /
+    ``x-request-timeout`` headers (seconds).
+  * ``backoff_delay`` — capped exponential backoff with full jitter for the
+    retry loop in request_service.
+
+Only PRE-STREAM failures (connect refused/timed out, 502/503 before any
+byte reaches the client) are retried; once bytes are on the wire a failure
+is truncation-only — the backend is marked, never the bytes resent.
+"""
+
+import random
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from production_stack_tpu.router import metrics
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+CLOSED, OPEN, HALF_OPEN = 0, 1, 2
+_STATE_NAMES = {CLOSED: "closed", OPEN: "open", HALF_OPEN: "half_open"}
+
+#: Backend HTTP statuses treated as a pre-stream backend failure (the pod
+#: is restarting / shedding); anything else is relayed to the client as-is.
+RETRYABLE_STATUSES = (502, 503)
+
+
+@dataclass
+class ResilienceConfig:
+    # Retry budget: total connection attempts per request (1 = no retry).
+    retry_max_attempts: int = 3
+    retry_backoff_base: float = 0.05   # first retry delay (seconds)
+    retry_backoff_cap: float = 1.0     # per-retry delay ceiling
+    # Circuit breaker: windowed error rate.
+    breaker_window: float = 30.0       # rolling outcome window (seconds)
+    breaker_min_requests: int = 5      # outcomes required before tripping
+    breaker_error_rate: float = 0.5    # windowed error rate that opens
+    breaker_open_duration: float = 10.0  # cooldown before the half-open probe
+    # Deadlines (0 disables). Header overrides are per request.
+    default_timeout: float = 300.0     # total request budget (seconds)
+    default_ttft_deadline: float = 0.0  # budget to the first backend byte
+    timeout_header: str = "x-request-timeout"
+    ttft_header: str = "x-ttft-deadline"
+
+
+class DeadlineExceeded(Exception):
+    """The request's TTFT or total budget ran out before/while talking to
+    ``backend_url``; ``kind`` is "ttft" or "total"."""
+
+    def __init__(self, kind: str, backend_url: str):
+        super().__init__(f"{kind} deadline exceeded talking to {backend_url}")
+        self.kind = kind
+        self.backend_url = backend_url
+
+
+class PreStreamFailure(Exception):
+    """Backend failed before any response byte reached the client —
+    safe to retry/fail over."""
+
+    def __init__(self, backend_url: str, reason: str,
+                 status: Optional[int] = None):
+        super().__init__(f"{backend_url}: {reason}")
+        self.backend_url = backend_url
+        self.reason = reason
+        self.status = status
+
+
+class Deadline:
+    """Per-request budgets measured from router ingress."""
+
+    def __init__(self, total: Optional[float] = None,
+                 ttft: Optional[float] = None,
+                 start: Optional[float] = None):
+        self.start = time.monotonic() if start is None else start
+        self.total = total or None     # 0/None -> disabled
+        self.ttft = ttft or None
+
+    @classmethod
+    def from_request(cls, headers, cfg: ResilienceConfig) -> "Deadline":
+        def _header_float(name: str, default: float) -> Optional[float]:
+            raw = headers.get(name) if headers is not None else None
+            if raw is None:
+                return default
+            try:
+                val = float(raw)
+            except (TypeError, ValueError):
+                return default
+            if val <= 0:        # invalid/non-positive: keep the default
+                return default
+            # Clients may only TIGHTEN the operator-configured bound, never
+            # extend or disable it (an unbounded override would let any
+            # client hold backend connections open indefinitely).
+            return min(val, default) if default else val
+
+        return cls(
+            total=_header_float(cfg.timeout_header, cfg.default_timeout),
+            ttft=_header_float(cfg.ttft_header, cfg.default_ttft_deadline),
+        )
+
+    def binding_kind(self) -> str:
+        """Which budget expires first while waiting for the first byte
+        (labels 504s and the deadline metric correctly when both are set)."""
+        if self.ttft is None:
+            return "total"
+        if self.total is None or self.ttft <= self.total:
+            return "ttft"
+        return "total"
+
+    def remaining_total(self) -> Optional[float]:
+        if self.total is None:
+            return None
+        return self.total - (time.monotonic() - self.start)
+
+    def remaining_ttft(self) -> Optional[float]:
+        """Budget to the first backend byte: min of the ttft and total
+        budgets (whichever runs out first aborts the wait)."""
+        rem_total = self.remaining_total()
+        if self.ttft is None:
+            return rem_total
+        rem_ttft = self.ttft - (time.monotonic() - self.start)
+        return rem_ttft if rem_total is None else min(rem_ttft, rem_total)
+
+    def expired(self) -> bool:
+        rem = self.remaining_total()
+        return rem is not None and rem <= 0
+
+
+def backoff_delay(attempt: int, cfg: ResilienceConfig) -> float:
+    """Capped exponential backoff with full jitter (attempt counts from 1)."""
+    ceiling = min(cfg.retry_backoff_cap,
+                  cfg.retry_backoff_base * (2 ** (attempt - 1)))
+    return ceiling * (0.5 + random.random() * 0.5)
+
+
+class CircuitBreaker:
+    """Rolling error-rate breaker for one backend."""
+
+    def __init__(self, url: str, cfg: ResilienceConfig):
+        self.url = url
+        self.cfg = cfg
+        self.state = CLOSED
+        self._outcomes: List = []      # (timestamp, ok) within the window
+        self._opened_at = 0.0
+        self._probe_at = 0.0           # when the half-open probe dispatched
+        self._publish()
+
+    def _publish(self) -> None:
+        metrics.router_circuit_state.labels(server=self.url).set(self.state)
+
+    def _trim(self, now: float) -> None:
+        cutoff = now - self.cfg.breaker_window
+        self._outcomes = [o for o in self._outcomes if o[0] >= cutoff]
+
+    # ------------------------------------------------------------- decisions
+    def allow(self) -> bool:
+        """May a request be sent to this backend right now? Side-effect-free
+        apart from the OPEN -> HALF_OPEN cooldown transition — the probe
+        slot is only consumed by ``on_dispatch`` (routing may check several
+        candidates but dispatch to one)."""
+        if self.state == CLOSED:
+            return True
+        now = time.monotonic()
+        if self.state == OPEN:
+            if now - self._opened_at < self.cfg.breaker_open_duration:
+                return False
+            self.state = HALF_OPEN
+            self._probe_at = 0.0
+            self._publish()
+            logger.info("Circuit %s: open -> half-open (probing)", self.url)
+        # HALF_OPEN: one probe at a time. The probe slot is a LEASE, not a
+        # flag — if the probe's outcome is never reported (e.g. the request
+        # hit its deadline), the slot frees itself after open_duration.
+        return now - self._probe_at >= self.cfg.breaker_open_duration
+
+    def on_dispatch(self) -> None:
+        """A request was actually sent to this backend."""
+        if self.state == HALF_OPEN:
+            self._probe_at = time.monotonic()
+
+    # -------------------------------------------------------------- outcomes
+    def record_success(self) -> None:
+        now = time.monotonic()
+        if self.state == HALF_OPEN:
+            self.state = CLOSED
+            self._outcomes = []
+            self._probe_at = 0.0
+            self._publish()
+            logger.info("Circuit %s: half-open -> closed (probe ok)", self.url)
+            return
+        self._outcomes.append((now, True))
+        self._trim(now)
+
+    def record_failure(self) -> None:
+        now = time.monotonic()
+        if self.state == HALF_OPEN:
+            self.state = OPEN
+            self._opened_at = now
+            self._probe_at = 0.0
+            self._publish()
+            logger.warning("Circuit %s: half-open -> open (probe failed)",
+                           self.url)
+            return
+        self._outcomes.append((now, False))
+        self._trim(now)
+        if self.state != CLOSED:
+            return
+        total = len(self._outcomes)
+        if total < self.cfg.breaker_min_requests:
+            return
+        failures = sum(1 for _, ok in self._outcomes if not ok)
+        if failures / total >= self.cfg.breaker_error_rate:
+            self.state = OPEN
+            self._opened_at = now
+            self._publish()
+            logger.warning(
+                "Circuit %s: closed -> open (%d/%d failures in %.0fs window)",
+                self.url, failures, total, self.cfg.breaker_window,
+            )
+
+
+class ResilienceManager:
+    """Per-backend breaker registry consulted by the proxy path."""
+
+    def __init__(self, config: Optional[ResilienceConfig] = None):
+        self.config = config or ResilienceConfig()
+        self._breakers: Dict[str, CircuitBreaker] = {}
+
+    def _breaker(self, url: str) -> CircuitBreaker:
+        br = self._breakers.get(url)
+        if br is None:
+            br = self._breakers[url] = CircuitBreaker(url, self.config)
+        return br
+
+    def allow(self, url: str) -> bool:
+        return self._breaker(url).allow()
+
+    def on_dispatch(self, url: str) -> None:
+        self._breaker(url).on_dispatch()
+
+    def record_success(self, url: str) -> None:
+        self._breaker(url).record_success()
+
+    def record_failure(self, url: str) -> None:
+        self._breaker(url).record_failure()
+
+    def state(self, url: str) -> int:
+        return self._breaker(url).state
+
+    def snapshot(self) -> Dict[str, str]:
+        """url -> state name, for the router's /health payload."""
+        return {
+            url: _STATE_NAMES[br.state]
+            for url, br in sorted(self._breakers.items())
+        }
+
+
+_resilience: Optional[ResilienceManager] = None
+
+
+def initialize_resilience(
+    config: Optional[ResilienceConfig] = None,
+) -> ResilienceManager:
+    global _resilience
+    _resilience = ResilienceManager(config)
+    return _resilience
+
+
+def get_resilience() -> Optional[ResilienceManager]:
+    return _resilience

@@ -48,10 +48,11 @@ def _parse_lora_modules(items) -> dict:
     return out
 
 
-def _error(status: int, message: str, etype: str = "invalid_request_error"):
+def _error(status: int, message: str, etype: str = "invalid_request_error",
+           headers: Optional[dict] = None):
     return web.json_response(
         ErrorResponse(message=message, type=etype, code=status).to_dict(),
-        status=status,
+        status=status, headers=headers,
     )
 
 
@@ -60,13 +61,79 @@ def _sse(obj: dict) -> bytes:
 
 
 class APIServer:
-    def __init__(self, engine: ServingEngine, api_key: Optional[str] = None):
+    def __init__(self, engine: ServingEngine, api_key: Optional[str] = None,
+                 drain_timeout: float = 30.0, max_queue_len: int = 0):
         self.engine = engine
         self.model_name = engine.config.model_name
         # Bearer auth parity: the reference stack passes VLLM_API_KEY to
         # engines and the router probe authenticates with it
         # (reference src/vllm_router/service_discovery.py:156-169).
         self.api_key = api_key
+        # Graceful drain (SIGTERM): readiness flips to 503 and admission
+        # stops, in-flight requests get up to drain_timeout to finish, the
+        # remainder is aborted. max_queue_len > 0 sheds new generation
+        # requests with 503 + Retry-After while the engine's wait queue is
+        # at least that deep (the router's failover/breaker overload signal).
+        self.drain_timeout = drain_timeout
+        self.max_queue_len = max_queue_len
+        self._draining = False
+        self._inflight = 0
+        self._drained = asyncio.Event()
+        self._drain_task: Optional[asyncio.Task] = None
+        self.on_drained = None   # callable run after drain (main: exit loop)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    # -------------------------------------------------------------- draining
+    def install_signal_handlers(self, loop) -> None:
+        """SIGTERM -> graceful drain (replacing aiohttp's immediate exit);
+        a second SIGTERM skips the drain wait."""
+        import signal
+
+        try:
+            loop.add_signal_handler(signal.SIGTERM, self._on_sigterm)
+        except (NotImplementedError, RuntimeError):  # non-main thread / win
+            logger.warning("Cannot install SIGTERM drain handler")
+
+    def _on_sigterm(self) -> None:
+        if self._drain_task is not None:
+            logger.warning("Second SIGTERM: exiting without finishing drain")
+            raise web.GracefulExit()
+        self._drain_task = asyncio.ensure_future(self._drain_and_exit())
+
+    async def _drain_and_exit(self) -> None:
+        await self.drain()
+        if self.on_drained is not None:
+            self.on_drained()
+
+    async def drain(self) -> None:
+        """Stop admitting, let in-flight requests finish up to
+        ``drain_timeout``, then abort the remainder."""
+        if self._draining:
+            return
+        self._draining = True
+        if self._inflight == 0:
+            self._drained.set()
+        logger.info("Drain: admission stopped, %d request(s) in flight",
+                    self._inflight)
+        try:
+            await asyncio.wait_for(self._drained.wait(), self.drain_timeout)
+            logger.info("Drain complete: all in-flight requests finished")
+        except asyncio.TimeoutError:
+            stale = self.engine.active_request_ids()
+            logger.warning("Drain timeout after %.1fs: aborting %d request(s)",
+                           self.drain_timeout, len(stale))
+            for rid in stale:
+                self.engine.abort(rid)
+            # Aborts are applied between device steps; give the handlers a
+            # moment to observe the finished streams and return.
+            try:
+                await asyncio.wait_for(self._drained.wait(), 5.0)
+            except asyncio.TimeoutError:
+                logger.warning("Drain: %d handler(s) still active at exit",
+                               self._inflight)
 
     def _served_models(self):
         """Base model plus registered LoRA adapter names: requesting
@@ -115,8 +182,29 @@ class APIServer:
                                   etype="authentication_error")
             return await handler(request)
 
+        @web.middleware
+        async def admission(request: web.Request, handler):
+            # Drain gate + in-flight accounting for every serving endpoint.
+            if request.method != "POST" or not (
+                request.path.startswith("/v1") or request.path == "/rerank"
+            ):
+                return await handler(request)
+            if self._draining:
+                return _error(
+                    503, "Server is draining (shutting down)",
+                    etype="service_unavailable",
+                    headers={"Retry-After": "5"},
+                )
+            self._inflight += 1
+            try:
+                return await handler(request)
+            finally:
+                self._inflight -= 1
+                if self._draining and self._inflight == 0:
+                    self._drained.set()
+
         app = web.Application(client_max_size=64 * 1024 * 1024,
-                              middlewares=[trace, auth])
+                              middlewares=[trace, auth, admission])
 
         async def on_startup(app):
             await self.engine.start()
@@ -222,6 +310,13 @@ class APIServer:
         )
 
     async def health(self, request: web.Request) -> web.Response:
+        if self._draining:
+            # K8s readiness drops the pod from Endpoints while in-flight
+            # streams finish (graceful drain).
+            return web.json_response(
+                {"status": "draining", "inflight": self._inflight},
+                status=503,
+            )
         if self.engine.is_healthy:
             return web.json_response({"status": "healthy"})
         return web.json_response({"status": "unhealthy"}, status=503)
@@ -461,6 +556,18 @@ class APIServer:
         OpenAI choices (prompt-major indexing), streaming or not. The
         engine's prefix cache dedups the shared prompt KV across an n>1
         fan-out, so extra choices cost decode only."""
+        # Admission shedding: refuse while the wait queue is over the bound
+        # so the router fails over / backs off instead of queueing blind.
+        if self.max_queue_len and (
+            self.engine.scheduler.num_waiting >= self.max_queue_len
+        ):
+            return _error(
+                503,
+                f"Engine overloaded: {self.engine.scheduler.num_waiting} "
+                f"requests waiting (bound {self.max_queue_len})",
+                etype="service_unavailable",
+                headers={"Retry-After": "1"},
+            )
         request_id = random_uuid("chatcmpl-" if chat else "cmpl-")
         created = int(time.time())
         stream = bool(body.get("stream", False))
@@ -824,14 +931,38 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--api-key", default=os.environ.get("VLLM_API_KEY"),
                    help="Require 'Authorization: Bearer <key>' on /v1/* "
                         "(defaults to $VLLM_API_KEY)")
+    p.add_argument("--drain-timeout", type=float, default=30.0,
+                   help="seconds SIGTERM waits for in-flight requests "
+                        "before aborting them (graceful drain)")
+    p.add_argument("--max-queue-len", type=int, default=0,
+                   help="shed new generation requests with 503 + "
+                        "Retry-After while the wait queue is at least this "
+                        "deep (0 disables)")
     return p.parse_args(argv)
 
 
 def main(argv=None) -> None:
     args = parse_args(argv)
     engine = build_engine_from_args(args)
-    server = APIServer(engine, api_key=args.api_key)
+    server = APIServer(engine, api_key=args.api_key,
+                       drain_timeout=args.drain_timeout,
+                       max_queue_len=args.max_queue_len)
     app = server.build_app()
+
+    def _exit_loop():
+        # GracefulExit subclasses SystemExit: raised from a loop callback it
+        # propagates out of run_forever and run_app cleans up normally.
+        def _raise():
+            raise web.GracefulExit()
+
+        asyncio.get_event_loop().call_soon(_raise)
+
+    server.on_drained = _exit_loop
+
+    async def _install_signals(app):
+        server.install_signal_handlers(asyncio.get_running_loop())
+
+    app.on_startup.append(_install_signals)
     logger.info("Engine API server on %s:%d (model=%s)",
                 args.host, args.port, server.model_name)
     web.run_app(app, host=args.host, port=args.port, print=None)

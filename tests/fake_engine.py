@@ -5,6 +5,16 @@ src/tests/perftest/fake-openai-server.py): a mock vLLM-protocol server that
 streams "Hello " at a configured speed with a configured TTFT, and exposes
 /metrics in vllm exposition format so the scraper, routing logic, and
 dashboards are all testable without TPUs.
+
+Fault-injection modes (tests/test_resilience.py harness):
+  * ``fail_for(seconds, status)`` — answer 503 (or another status) for a
+    window, like a pod that is restarting or shedding load;
+  * ``refuse_connections = True`` — hard-close the transport before any
+    response bytes, like a dead pod (client sees a disconnect);
+  * ``die_after_chunks = N`` — stream N SSE chunks then kill the
+    connection, the mid-stream failure class;
+  * ``extra_latency = T`` — hang T seconds before the first byte, for
+    deadline tests.
 """
 
 import asyncio
@@ -28,6 +38,25 @@ class FakeEngine:
         self.kv_usage = 0.0
         self.requests_seen = []     # (endpoint, body) tuples for assertions
         self.headers_seen = []      # request headers per completion call
+        # ---- fault injection ----
+        self.unavailable_until = 0.0     # 503 while time.time() < this
+        self.unavailable_status = 503
+        self.refuse_connections = False  # kill the transport pre-response
+        self.die_after_chunks = None     # kill the transport mid-stream
+        self.extra_latency = 0.0         # hang before the first byte
+        self.faults_served = 0           # how many requests hit a fault
+
+    def fail_for(self, seconds: float, status: int = 503) -> None:
+        """Return ``status`` for the next ``seconds`` seconds."""
+        self.unavailable_until = time.time() + seconds
+        self.unavailable_status = status
+
+    def heal(self) -> None:
+        """Clear every injected fault."""
+        self.unavailable_until = 0.0
+        self.refuse_connections = False
+        self.die_after_chunks = None
+        self.extra_latency = 0.0
 
     def build_app(self) -> web.Application:
         app = web.Application()
@@ -65,6 +94,21 @@ class FakeEngine:
         return await self._complete(request, chat=False)
 
     async def _complete(self, request, chat: bool):
+        if self.refuse_connections:
+            # Dead-pod simulation: kill the TCP transport before any
+            # response bytes; the client sees a server disconnect.
+            self.faults_served += 1
+            request.transport.close()
+            raise ConnectionResetError("fault injection: refusing connection")
+        if time.time() < self.unavailable_until:
+            self.faults_served += 1
+            return web.json_response(
+                {"error": {"message": "fault injection: unavailable",
+                           "type": "service_unavailable",
+                           "code": self.unavailable_status}},
+                status=self.unavailable_status,
+                headers={"Retry-After": "1"},
+            )
         body = json.loads(await request.read())
         self.requests_seen.append(
             ("/v1/chat/completions" if chat else "/v1/completions", body)
@@ -74,6 +118,8 @@ class FakeEngine:
         stream = bool(body.get("stream", False))
         self.running += 1
         try:
+            if self.extra_latency:
+                await asyncio.sleep(self.extra_latency)
             if self.ttft:
                 await asyncio.sleep(self.ttft)
             if not stream:
@@ -100,6 +146,13 @@ class FakeEngine:
             )
             await resp.prepare(request)
             for i in range(n):
+                if (self.die_after_chunks is not None
+                        and i >= self.die_after_chunks):
+                    # Mid-stream death: kill the transport with the stream
+                    # half-written (the truncation-only failure class).
+                    self.faults_served += 1
+                    request.transport.close()
+                    return resp
                 chunk = {
                     "id": "fake-cmpl", "created": int(time.time()),
                     "model": self.model,

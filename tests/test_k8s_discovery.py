@@ -1,0 +1,226 @@
+"""K8sPodIPServiceDiscovery against a fake Kubernetes pods API.
+
+Extends the FakeK8s harness from tests/test_staticroute_operator.py with
+the pods LIST + WATCH surface the discovery thread actually speaks
+(production_stack_tpu/router/service_discovery.py):
+
+  * watch-event parsing (ADDED / MODIFIED / DELETED);
+  * readiness gating — only ready pods with a podIP are routable;
+  * reconnect after a watch stream error, with LIST reconciliation of
+    deletions lost between streams;
+  * resourceVersion bookkeeping — each watch resumes from the LIST's
+    resourceVersion;
+  * /v1/models probing of ready pods (via the FakeEngine surface).
+"""
+
+import asyncio
+import json
+import time
+
+from aiohttp import web
+from aiohttp.test_utils import TestServer
+
+from production_stack_tpu.router.service_discovery import (
+    K8sPodIPServiceDiscovery,
+)
+from tests.fake_engine import FakeEngine
+from tests.test_staticroute_operator import FakeK8s
+
+
+class FakeK8sPods(FakeK8s):
+    """FakeK8s plus the core /api/v1 pods LIST + WATCH endpoints."""
+
+    def __init__(self):
+        super().__init__()
+        self.pods = {}            # name -> manifest
+        self.resource_version = 100
+        self.list_calls = []      # query params per LIST
+        self.watch_calls = []     # query params per WATCH
+        self.closing = False
+        self.fail_next_list = False
+        self._watchers = []
+
+    def app(self) -> web.Application:
+        app = super().app()
+        app.router.add_get("/api/v1/namespaces/{ns}/pods", self._pods)
+        return app
+
+    async def _pods(self, req):
+        params = dict(req.query)
+        if params.get("watch") != "true":
+            self.list_calls.append(params)
+            if self.fail_next_list:
+                self.fail_next_list = False
+                return web.json_response({"kind": "Status", "code": 500},
+                                         status=500)
+            self.resource_version += 1
+            return web.json_response({
+                "metadata": {"resourceVersion": str(self.resource_version)},
+                "items": list(self.pods.values()),
+            })
+        self.watch_calls.append(params)
+        if self.closing:
+            return web.json_response({"kind": "Status", "code": 410},
+                                     status=410)
+        resp = web.StreamResponse()
+        resp.content_type = "application/json"
+        await resp.prepare(req)
+        q: asyncio.Queue = asyncio.Queue()
+        self._watchers.append(q)
+        try:
+            while True:
+                item = await q.get()
+                if item is None:    # simulated stream error/expiry
+                    break
+                await resp.write(json.dumps(item).encode() + b"\n")
+        finally:
+            self._watchers.remove(q)
+        await resp.write_eof()
+        return resp
+
+    def push(self, etype: str, pod: dict) -> None:
+        for q in list(self._watchers):
+            q.put_nowait({"type": etype, "object": pod})
+
+    def end_watch(self) -> None:
+        for q in list(self._watchers):
+            q.put_nowait(None)
+
+
+def _pod(name: str, ip="127.0.0.1", ready=True, with_ip=True):
+    status = {"containerStatuses": [{"ready": ready}]}
+    if with_ip:
+        status["podIP"] = ip
+    return {"metadata": {"name": name}, "status": status}
+
+
+async def _serve(app):
+    srv = TestServer(app)
+    await srv.start_server()
+    return srv, f"http://127.0.0.1:{srv.port}"
+
+
+async def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        await asyncio.sleep(0.02)
+    return False
+
+
+async def _shutdown(disc, fake, srv):
+    disc.close()
+    for _ in range(5):          # flush any watcher (re)connections
+        fake.closing = True
+        fake.end_watch()
+        await asyncio.sleep(0.05)
+    await srv.close()
+
+
+async def test_watch_event_parsing_and_readiness_gating():
+    fake = FakeK8sPods()
+    fake.pods["pod-a"] = _pod("pod-a")
+    srv, base = await _serve(fake.app())
+    disc = K8sPodIPServiceDiscovery(
+        namespace="default", port=9000, api_base=base, token="",
+        probe_models=False,
+    )
+    try:
+        urls = lambda: {ep.url for ep in disc.get_endpoint_info()}
+        assert await _wait(lambda: urls() == {"http://127.0.0.1:9000"})
+
+        # Readiness flapping: NotReady removes, Ready re-adds.
+        fake.push("MODIFIED", _pod("pod-a", ready=False))
+        assert await _wait(lambda: not urls())
+        fake.push("MODIFIED", _pod("pod-a", ready=True))
+        assert await _wait(lambda: urls() == {"http://127.0.0.1:9000"})
+
+        # ADDED second pod; DELETED removes it again.
+        fake.push("ADDED", _pod("pod-b", ip="127.0.0.2"))
+        assert await _wait(lambda: len(urls()) == 2)
+        fake.push("DELETED", _pod("pod-b", ip="127.0.0.2"))
+        assert await _wait(lambda: urls() == {"http://127.0.0.1:9000"})
+
+        # Ready but no podIP yet (scheduling): not routable.
+        fake.push("ADDED", _pod("pod-c", with_ip=False))
+        await asyncio.sleep(0.1)
+        assert urls() == {"http://127.0.0.1:9000"}
+
+        assert disc.get_health()
+    finally:
+        await _shutdown(disc, fake, srv)
+
+
+async def test_reconnect_reconciles_and_tracks_resource_version():
+    fake = FakeK8sPods()
+    fake.pods["pod-a"] = _pod("pod-a")
+    srv, base = await _serve(fake.app())
+    disc = K8sPodIPServiceDiscovery(
+        namespace="default", port=9000, api_base=base, token="",
+        probe_models=False,
+    )
+    try:
+        assert await _wait(lambda: len(disc.get_endpoint_info()) == 1)
+        # The first watch resumed from the first LIST's resourceVersion.
+        assert await _wait(lambda: len(fake.watch_calls) >= 1)
+        first_rv = str(fake.resource_version)
+        assert fake.watch_calls[0]["resourceVersion"] == first_rv
+
+        # Pod dies while the watch stream is down: the DELETED event is
+        # never delivered, the reconnect's LIST must reconcile it away.
+        del fake.pods["pod-a"]
+        fake.end_watch()
+        assert await _wait(lambda: not disc.get_endpoint_info())
+        assert len(fake.list_calls) >= 2
+        # The re-watch resumed from the NEW list's resourceVersion.
+        assert await _wait(lambda: len(fake.watch_calls) >= 2)
+        assert fake.watch_calls[-1]["resourceVersion"] == str(
+            fake.resource_version
+        )
+        assert fake.watch_calls[-1]["resourceVersion"] != first_rv
+    finally:
+        await _shutdown(disc, fake, srv)
+
+
+async def test_watch_survives_api_server_error():
+    fake = FakeK8sPods()
+    fake.fail_next_list = True      # first LIST 500s; stream must self-heal
+    fake.pods["pod-a"] = _pod("pod-a")
+    srv, base = await _serve(fake.app())
+    disc = K8sPodIPServiceDiscovery(
+        namespace="default", port=9000, api_base=base, token="",
+        probe_models=False,
+    )
+    try:
+        assert await _wait(lambda: len(disc.get_endpoint_info()) == 1,
+                           timeout=8.0)
+        assert len(fake.list_calls) >= 2
+    finally:
+        await _shutdown(disc, fake, srv)
+
+
+async def test_ready_pods_probed_for_models():
+    """Ready pods are probed at /v1/models so the router can filter
+    endpoints by served model (the FakeEngine provides the surface)."""
+    engine = FakeEngine(model="m-probed")
+    esrv = TestServer(engine.build_app())
+    await esrv.start_server()
+
+    fake = FakeK8sPods()
+    fake.pods["pod-a"] = _pod("pod-a")
+    srv, base = await _serve(fake.app())
+    disc = K8sPodIPServiceDiscovery(
+        namespace="default", port=esrv.port, api_base=base, token="",
+    )
+    try:
+        assert await _wait(
+            lambda: [ep.model_names for ep in disc.get_endpoint_info()]
+            == [["m-probed"]]
+        )
+        ep = disc.get_endpoint_info()[0]
+        assert ep.url == f"http://127.0.0.1:{esrv.port}"
+        assert ep.pod_name == "pod-a"
+    finally:
+        await _shutdown(disc, fake, srv)
+        await esrv.close()

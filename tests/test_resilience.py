@@ -1,0 +1,477 @@
+"""Fault-injection harness for the resilient data plane.
+
+Covers the acceptance contract of the resilience layer end to end, with the
+real router app and fault-injectable fake engines (tests/fake_engine.py):
+
+  * rolling backend restart with ZERO client-visible 5xx — pre-stream
+    failures retry + fail over, the dead backend's circuit opens, and a
+    half-open probe re-admits it after recovery;
+  * breaker state machine unit cycle (closed -> open -> half-open ->
+    closed / re-open);
+  * TTFT + total deadlines against a hung backend -> clean 504;
+  * mid-stream death -> truncation only (no resend), backend marked;
+  * engine graceful drain on SIGTERM: in-flight streams finish, /health
+    turns 503, new requests are refused;
+  * queue-depth admission shedding (503 + Retry-After).
+"""
+
+import asyncio
+import json
+import os
+import signal
+import time
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from production_stack_tpu.router.resilience import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+    ResilienceConfig,
+    get_resilience,
+)
+from tests.fake_engine import FakeEngine
+from tests.test_router_e2e import _start_stack, _stop_stack
+
+
+# --------------------------------------------------------------------------
+# Router: retry / failover / breaker (fault-injected fake engines)
+# --------------------------------------------------------------------------
+async def _post_ok(client, **kwargs):
+    resp = await client.post("/v1/completions", json={
+        "model": "m1", "prompt": "x", "max_tokens": 2,
+    }, **kwargs)
+    await resp.read()
+    return resp.status
+
+
+async def test_rolling_restart_zero_5xx_and_breaker_cycle():
+    """Acceptance e2e: 3 backends, each killed in turn under load — every
+    request succeeds via failover, the killed backend's circuit opens, and
+    the half-open probe re-admits it after it heals."""
+    engines, servers, urls, client = await _start_stack(
+        n_engines=3,
+        # Short window so one round's recovery successes age out before the
+        # next victim's failure burst (keeps the open decision deterministic).
+        breaker_window=0.2, breaker_min_requests=2, breaker_error_rate=0.5,
+        breaker_open_duration=0.3, retry_max_attempts=4,
+    )
+    try:
+        manager = get_resilience()
+        for victim in range(3):
+            engines[victim].refuse_connections = True
+            statuses = await asyncio.gather(
+                *[_post_ok(client) for _ in range(8)]
+            )
+            assert statuses == [200] * 8, statuses  # zero client-visible 5xx
+            assert engines[victim].faults_served >= 2
+            assert manager.state(urls[victim]) == OPEN
+
+            # While open, the victim receives no traffic at all.
+            served_before = len(engines[victim].requests_seen)
+            assert await _post_ok(client) == 200
+            assert len(engines[victim].requests_seen) == served_before
+
+            # Heal; after the cooldown, a half-open probe re-admits it.
+            engines[victim].heal()
+            await asyncio.sleep(0.35)
+            for _ in range(6):
+                assert await _post_ok(client) == 200
+            assert manager.state(urls[victim]) == CLOSED
+            assert len(engines[victim].requests_seen) > served_before
+            # Let this round's successes fall out of the breaker window.
+            await asyncio.sleep(0.25)
+
+        # The resilience series are scrapeable after all that churn.
+        resp = await client.get("/metrics")
+        text = await resp.text()
+        for series in ("router_retries_total", "router_failovers_total",
+                       "router_circuit_state"):
+            assert series in text, series
+        # /health surfaces the breaker snapshot, all closed again.
+        health = await (await client.get("/health")).json()
+        assert health["circuit_breakers"] == {u: "closed" for u in urls}
+    finally:
+        await _stop_stack(servers, client)
+
+
+async def test_503_backend_fails_over_pre_stream():
+    """A backend answering 503 (restarting/shedding) never surfaces to the
+    client while a healthy peer exists — including for streaming requests,
+    where failover must happen before any SSE bytes."""
+    engines, servers, urls, client = await _start_stack(
+        n_engines=2, breaker_min_requests=100,  # keep the breaker out of it
+    )
+    try:
+        engines[0].fail_for(30.0)
+        for _ in range(4):
+            assert await _post_ok(client) == 200
+        resp = await client.post("/v1/completions", json={
+            "model": "m1", "prompt": "x", "max_tokens": 4, "stream": True,
+        })
+        assert resp.status == 200
+        raw = (await resp.content.read()).decode()
+        assert raw.count("data:") == 5  # 4 chunks + [DONE]
+        assert all(e is engines[0] or len(e.requests_seen) >= 5
+                   for e in engines)
+        assert not engines[0].requests_seen
+    finally:
+        await _stop_stack(servers, client)
+
+
+async def test_all_backends_dead_clean_502():
+    """Retry budget exhausted with every backend down -> one clean 502
+    (not a hang, not a stack trace)."""
+    engines, servers, urls, client = await _start_stack(
+        n_engines=1, breaker_min_requests=100, retry_max_attempts=2,
+    )
+    try:
+        engines[0].refuse_connections = True
+        resp = await client.post("/v1/completions", json={
+            "model": "m1", "prompt": "x",
+        })
+        assert resp.status == 502
+        body = await resp.json()
+        assert body["error"]["type"] == "bad_gateway"
+        assert engines[0].faults_served == 2  # both budgeted attempts used
+    finally:
+        await _stop_stack(servers, client)
+
+
+async def test_open_circuits_everywhere_clean_503():
+    """Every circuit open -> immediate 503 with Retry-After, no backend
+    traffic (the router sheds instead of hammering dead pods)."""
+    engines, servers, urls, client = await _start_stack(
+        n_engines=1, breaker_min_requests=2, breaker_error_rate=0.1,
+        breaker_open_duration=60.0, retry_max_attempts=2,
+    )
+    try:
+        engines[0].refuse_connections = True
+        assert (await client.post("/v1/completions", json={
+            "model": "m1", "prompt": "x",
+        })).status == 502         # its second failure opens the circuit
+        assert get_resilience().state(urls[0]) == OPEN
+        faults_before = engines[0].faults_served
+        resp = await client.post("/v1/completions", json={
+            "model": "m1", "prompt": "x",
+        })
+        assert resp.status == 503
+        assert resp.headers.get("Retry-After")
+        assert engines[0].faults_served == faults_before  # never dialed
+    finally:
+        await _stop_stack(servers, client)
+
+
+async def test_relayed_500_still_trips_breaker():
+    """Non-retryable 5xx (e.g. 500) is relayed to the client as-is, but
+    still counts as a breaker failure — a backend wedged on 500s must trip
+    its circuit so traffic moves away."""
+    engines, servers, urls, client = await _start_stack(
+        n_engines=2, breaker_min_requests=2, breaker_error_rate=0.5,
+        breaker_open_duration=60.0,
+    )
+    try:
+        engines[0].fail_for(30.0, status=500)
+        statuses = [await _post_ok(client) for _ in range(8)]
+        assert statuses.count(500) == 2       # relayed until the circuit trips
+        assert statuses[-3:] == [200] * 3     # then all traffic moves away
+        assert get_resilience().state(urls[0]) == OPEN
+    finally:
+        await _stop_stack(servers, client)
+
+
+async def test_client_disconnect_does_not_mark_backend():
+    """A client aborting its own stream must NOT count as a backend
+    failure — routine client cancels cannot open a healthy circuit."""
+    engines, servers, urls, client = await _start_stack(n_engines=1)
+    try:
+        engines[0].speed = 50.0   # slow stream so the abort lands mid-relay
+        resp = await client.post("/v1/completions", json={
+            "model": "m1", "prompt": "x", "max_tokens": 50, "stream": True,
+        })
+        assert resp.status == 200
+        await resp.content.read(10)
+        resp.close()              # client goes away mid-stream
+        await asyncio.sleep(0.3)
+        br = get_resilience()._breakers.get(urls[0])
+        assert br is None or all(ok for _, ok in br._outcomes)
+        assert get_resilience().state(urls[0]) == CLOSED
+    finally:
+        await _stop_stack(servers, client)
+
+
+# --------------------------------------------------------------------------
+# Deadlines
+# --------------------------------------------------------------------------
+async def test_ttft_deadline_on_hung_backend():
+    """A hung backend (no first byte) is aborted at the TTFT deadline with
+    a clean 504, well before the total timeout."""
+    engines, servers, urls, client = await _start_stack(n_engines=1)
+    try:
+        engines[0].extra_latency = 2.0
+        t0 = time.monotonic()
+        resp = await client.post(
+            "/v1/completions",
+            json={"model": "m1", "prompt": "x"},
+            headers={"x-ttft-deadline": "0.3"},
+        )
+        elapsed = time.monotonic() - t0
+        assert resp.status == 504
+        assert (await resp.json())["error"]["type"] == "deadline_exceeded"
+        assert elapsed < 5.0, elapsed
+
+        metrics_text = await (await client.get("/metrics")).text()
+        assert 'router_deadline_exceeded_total{kind="ttft"' in metrics_text
+    finally:
+        await _stop_stack(servers, client)
+
+
+async def test_total_timeout_header_pre_stream():
+    engines, servers, urls, client = await _start_stack(n_engines=1)
+    try:
+        engines[0].extra_latency = 2.0
+        resp = await client.post(
+            "/v1/completions",
+            json={"model": "m1", "prompt": "x"},
+            headers={"x-request-timeout": "0.3"},
+        )
+        assert resp.status == 504
+    finally:
+        await _stop_stack(servers, client)
+
+
+async def test_ttft_deadline_router_flag_default():
+    """The --ttft-deadline flag applies without any client header."""
+    engines, servers, urls, client = await _start_stack(
+        n_engines=1, ttft_deadline=0.3,
+    )
+    try:
+        engines[0].extra_latency = 2.0
+        resp = await client.post("/v1/completions", json={
+            "model": "m1", "prompt": "x",
+        })
+        assert resp.status == 504
+    finally:
+        await _stop_stack(servers, client)
+
+
+async def test_mid_stream_death_truncates_never_resends():
+    """A backend dying mid-SSE truncates the client stream (no resend, no
+    second response) and marks the backend for the breaker."""
+    engines, servers, urls, client = await _start_stack(n_engines=1)
+    try:
+        engines[0].die_after_chunks = 3
+        resp = await client.post("/v1/completions", json={
+            "model": "m1", "prompt": "x", "max_tokens": 10, "stream": True,
+        })
+        assert resp.status == 200   # headers were already on the wire
+        raw = (await resp.content.read()).decode()
+        events = [ln for ln in raw.splitlines() if ln.startswith("data:")]
+        assert 0 < len(events) <= 3          # truncated, not resent
+        assert "data: [DONE]" not in events  # visibly incomplete
+        # The failure was recorded against the backend.
+        br = get_resilience()._breakers[urls[0]]
+        assert any(not ok for _, ok in br._outcomes)
+        # One backend attempt only: mid-stream is never retried.
+        assert len(engines[0].requests_seen) == 1
+    finally:
+        await _stop_stack(servers, client)
+
+
+# --------------------------------------------------------------------------
+# Breaker unit cycle
+# --------------------------------------------------------------------------
+def test_circuit_breaker_state_machine():
+    cfg = ResilienceConfig(
+        breaker_window=10.0, breaker_min_requests=4,
+        breaker_error_rate=0.5, breaker_open_duration=0.05,
+    )
+    br = CircuitBreaker("http://e1", cfg)
+    assert br.state == CLOSED and br.allow()
+
+    # Below min_requests nothing trips, whatever the rate.
+    for _ in range(3):
+        br.record_failure()
+    assert br.state == CLOSED
+
+    # Error rate at/over threshold with enough outcomes -> OPEN.
+    br.record_success()
+    br.record_failure()     # 4 failures / 5 outcomes = 0.8 >= 0.5
+    assert br.state == OPEN
+    assert not br.allow()
+
+    # Cooldown elapses -> HALF_OPEN, exactly one probe until its outcome.
+    time.sleep(0.06)
+    assert br.allow()
+    assert br.state == HALF_OPEN
+    br.on_dispatch()
+    assert not br.allow()   # probe slot leased
+    br.record_failure()     # probe failed -> OPEN again
+    assert br.state == OPEN and not br.allow()
+
+    # Second cycle: probe succeeds -> CLOSED with a clean window.
+    time.sleep(0.06)
+    assert br.allow()
+    br.on_dispatch()
+    br.record_success()
+    assert br.state == CLOSED and br.allow()
+
+
+def test_breaker_window_expires_old_outcomes():
+    cfg = ResilienceConfig(
+        breaker_window=0.05, breaker_min_requests=3, breaker_error_rate=0.5,
+    )
+    br = CircuitBreaker("http://e1", cfg)
+    br.record_failure()
+    br.record_failure()
+    time.sleep(0.08)        # the two failures age out of the window
+    br.record_failure()
+    assert br.state == CLOSED  # only 1 outcome in window < min_requests
+
+
+# --------------------------------------------------------------------------
+# Engine: graceful drain + queue shedding (real ServingEngine, tiny model)
+# --------------------------------------------------------------------------
+def _engine_server(**kwargs):
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.engine import ServingEngine
+    from production_stack_tpu.server.api_server import APIServer
+
+    cfg = EngineConfig(
+        model="tiny-llama", max_model_len=256, block_size=4,
+        num_kv_blocks=128, max_num_seqs=8, max_num_batched_tokens=32,
+        attn_impl="xla",
+    )
+    return APIServer(ServingEngine(cfg), **kwargs)
+
+
+async def test_sigterm_drains_inflight_then_exits():
+    """Acceptance e2e: SIGTERM -> /health 503 + new requests refused while
+    the in-flight stream runs to completion, then the exit hook fires."""
+    server = _engine_server(drain_timeout=30.0)
+    drained = asyncio.Event()
+    server.on_drained = drained.set
+    client = TestClient(TestServer(server.build_app()))
+    await client.start_server()
+    try:
+        server.install_signal_handlers(asyncio.get_running_loop())
+        resp = await client.post("/v1/completions", json={
+            "model": "tiny-llama", "prompt": "hello", "max_tokens": 24,
+            "stream": True, "ignore_eos": True, "temperature": 0,
+        })
+        assert resp.status == 200
+
+        os.kill(os.getpid(), signal.SIGTERM)
+        await asyncio.sleep(0.05)
+        assert server.draining
+
+        health = await client.get("/health")
+        assert health.status == 503
+        assert (await health.json())["status"] == "draining"
+
+        refused = await client.post("/v1/completions", json={
+            "model": "tiny-llama", "prompt": "x", "max_tokens": 2,
+        })
+        assert refused.status == 503
+        assert refused.headers.get("Retry-After")
+
+        # The in-flight stream still completes in full.
+        raw = (await resp.content.read()).decode()
+        events = [ln for ln in raw.splitlines() if ln.startswith("data:")]
+        assert events[-1] == "data: [DONE]"
+        chunks = [json.loads(e[5:]) for e in events[:-1]]
+        finish = [c["choices"][0]["finish_reason"] for c in chunks
+                  if c["choices"] and c["choices"][0]["finish_reason"]]
+        assert finish == ["length"]
+
+        await asyncio.wait_for(drained.wait(), 10.0)
+    finally:
+        asyncio.get_running_loop().remove_signal_handler(signal.SIGTERM)
+        await client.close()
+
+
+async def test_drain_timeout_aborts_stragglers():
+    """In-flight requests that outlive drain_timeout are aborted, not
+    leaked — drain() itself returns promptly."""
+    server = _engine_server(drain_timeout=0.3)
+    client = TestClient(TestServer(server.build_app()))
+    await client.start_server()
+    try:
+        resp = await client.post("/v1/completions", json={
+            "model": "tiny-llama", "prompt": "hello", "max_tokens": 200,
+            "stream": True, "ignore_eos": True, "temperature": 0,
+        })
+        assert resp.status == 200
+        t0 = time.monotonic()
+        await server.drain()
+        assert time.monotonic() - t0 < 10.0
+        # Stream ended (aborted server-side) rather than hanging.
+        await asyncio.wait_for(resp.content.read(), 10.0)
+        assert not server.engine.active_request_ids()
+    finally:
+        await client.close()
+
+
+async def test_queue_depth_shedding():
+    """Wait queue over --max-queue-len -> 503 + Retry-After; back under the
+    bound -> served again."""
+    from types import SimpleNamespace
+
+    server = _engine_server(max_queue_len=2)
+    client = TestClient(TestServer(server.build_app()))
+    await client.start_server()
+    real_scheduler = server.engine.scheduler
+    try:
+        server.engine.scheduler = SimpleNamespace(num_waiting=3)
+        resp = await client.post("/v1/completions", json={
+            "model": "tiny-llama", "prompt": "x", "max_tokens": 2,
+        })
+        assert resp.status == 503
+        assert resp.headers.get("Retry-After") == "1"
+        assert (await resp.json())["error"]["type"] == "service_unavailable"
+
+        server.engine.scheduler = real_scheduler
+        resp = await client.post("/v1/completions", json={
+            "model": "tiny-llama", "prompt": "x", "max_tokens": 2,
+            "temperature": 0, "ignore_eos": True,
+        })
+        assert resp.status == 200
+    finally:
+        server.engine.scheduler = real_scheduler
+        await client.close()
+
+
+# --------------------------------------------------------------------------
+# Batch path rides the same resilience wrapper
+# --------------------------------------------------------------------------
+async def test_inprocess_batch_request_survives_backend_restart(tmp_path):
+    """The batch processor's send path retries through the resilience
+    wrapper instead of dying on the first aiohttp error."""
+    engines, servers, urls, client = await _start_stack(
+        n_engines=2, breaker_min_requests=100, retry_max_attempts=4,
+        enable_batch_api=True, file_storage_path=str(tmp_path),
+    )
+    try:
+        from production_stack_tpu.router.app import _inprocess_request
+
+        engines[0].fail_for(30.0)   # one backend down; wrapper must fail over
+        out = await _inprocess_request(
+            client.app, "/v1/completions",
+            {"model": "m1", "prompt": "x", "max_tokens": 2},
+        )
+        assert out["choices"][0]["text"].startswith("Hello")
+
+        # Both down -> a RuntimeError (the processor records a failed line),
+        # not an unhandled aiohttp exception type.
+        engines[1].fail_for(30.0)
+        try:
+            await _inprocess_request(
+                client.app, "/v1/completions",
+                {"model": "m1", "prompt": "x", "max_tokens": 2},
+            )
+            raise AssertionError("expected RuntimeError")
+        except RuntimeError:
+            pass
+    finally:
+        await _stop_stack(servers, client)

@@ -29,6 +29,12 @@ def router_args(backends, models, routing="roundrobin", **overrides):
         enable_batch_api=False, file_storage_class="local_file",
         file_storage_path=None, batch_processor="local",
         request_rewriter="noop", callbacks="",
+        # Resilience knobs (fast defaults for tests; see docs/RESILIENCE.md)
+        retry_max_attempts=3, retry_backoff_base=0.01,
+        retry_backoff_cap=0.05, breaker_window=30.0,
+        breaker_min_requests=5, breaker_error_rate=0.5,
+        breaker_open_duration=10.0, request_timeout=300.0,
+        ttft_deadline=0.0,
     )
     base.update(overrides)
     return argparse.Namespace(**base)
@@ -166,6 +172,33 @@ async def test_health_and_metrics_endpoints():
         assert "vllm:current_qps" in text
         assert "vllm:healthy_pods_total" in text
         assert 'vllm:gpu_prefix_cache_hit_rate' in text
+    finally:
+        await _stop_stack(servers, client)
+
+
+async def test_request_id_forwarded_end_to_end():
+    """The client's x-request-id reaches the BACKEND (router<->engine log
+    correlation) and is echoed back to the client."""
+    engines, servers, urls, client = await _start_stack(n_engines=1)
+    try:
+        resp = await client.post(
+            "/v1/completions",
+            json={"model": "m1", "prompt": "x", "max_tokens": 2},
+            headers={"x-request-id": "req-corr-42"},
+        )
+        assert resp.status == 200
+        assert resp.headers["x-request-id"] == "req-corr-42"
+        seen = {k.lower(): v for k, v in engines[0].headers_seen[-1].items()}
+        assert seen["x-request-id"] == "req-corr-42"
+
+        # Without a client-supplied id the router still mints one for the
+        # backend so engine logs are always correlatable.
+        resp = await client.post("/v1/completions", json={
+            "model": "m1", "prompt": "x", "max_tokens": 2,
+        })
+        assert resp.status == 200
+        seen = {k.lower(): v for k, v in engines[0].headers_seen[-1].items()}
+        assert seen["x-request-id"] == resp.headers["x-request-id"]
     finally:
         await _stop_stack(servers, client)
 
