@@ -49,6 +49,48 @@ def _roofline_tok_s(model: str, dtype_bytes: float, batch: int,
     return PEAK_HBM_GBS * 1e9 / (param_bytes / batch + kv_bytes_per_tok)
 
 
+# Byte-level fallback tokenizer yield: ~150 words of filler tokenize to
+# ~1000 tokens (docs/PERF.md measurement), so words = tokens * 0.15.
+WORDS_PER_TOKEN = 0.15
+
+
+def _history_words(args) -> int:
+    """Per-user seeded history in WORDS, clamped so the deepest round's
+    context (system prompt + history + all rounds' questions/answers) still
+    fits max_model_len. The reference shape is 20k history tokens —
+    request it with --history-tokens 20000 --max-model-len 32768."""
+    if args.history_tokens <= 0:
+        return 0
+    system_tokens = int(args.prompt_len / WORDS_PER_TOKEN)
+    per_round = args.max_tokens + 150  # answer + tagged question
+    budget = (args.max_model_len - system_tokens
+              - args.rounds * per_round - 512)
+    tokens = max(0, min(args.history_tokens, budget))
+    if tokens < args.history_tokens:
+        print(
+            f"note: clamping --history-tokens {args.history_tokens} -> "
+            f"{tokens} to fit --max-model-len {args.max_model_len}",
+            file=sys.stderr,
+        )
+    return int(tokens * WORDS_PER_TOKEN)
+
+
+def _scrape_prefix_counters(engine_urls) -> tuple:
+    """(hit_tokens, query_tokens) summed over the engines' /metrics."""
+    import urllib.request
+
+    hits = queries = 0.0
+    for url in engine_urls:
+        with urllib.request.urlopen(f"{url}/metrics", timeout=10) as resp:
+            text = resp.read().decode("utf-8", "replace")
+        for line in text.splitlines():
+            if line.startswith("vllm:gpu_prefix_cache_hits_total"):
+                hits += float(line.rsplit(" ", 1)[1])
+            elif line.startswith("vllm:gpu_prefix_cache_queries_total"):
+                queries += float(line.rsplit(" ", 1)[1])
+    return hits, queries
+
+
 # --------------------------------------------------------------- stack mode
 def bench_stack(args) -> dict:
     from benchmarks.multi_round_qa import (
@@ -66,9 +108,11 @@ def bench_stack(args) -> dict:
             "--attn-impl", args.attn_impl,
             *(["--decode-loop", args.decode_loop]
               if args.decode_loop else []),
+            *(["--no-overlap-dispatch"] if args.no_overlap else []),
         ],
-        routing_logic="session",
+        routing_logic=args.routing_logic,
         router_args=["--session-key", "x-user-id"],
+        num_engines=args.num_engines,
     )
     try:
         cfg = WorkloadConfig(
@@ -78,16 +122,23 @@ def bench_stack(args) -> dict:
             num_rounds=args.rounds,
             system_prompt_words=args.prompt_len,
             answer_tokens=args.max_tokens,
+            history_words=_history_words(args),
         )
         # Warmup: the same shapes as the measurement so every bucket the
         # timed region hits (prefill chunks, the fused decode scan) is
         # compiled before timing starts — but with a distinct question tag so
         # only the intentionally shared system prefix is warm in the prefix
-        # cache, never the timed rounds' full prompts.
+        # cache, never the timed rounds' full prompts or histories (the
+        # warmup pass seeds DIFFERENT history text — see UserSession).
         warm = WorkloadConfig(**{**cfg.__dict__, "num_rounds": 2,
                                  "tag": "warmup"})
         asyncio.run(run_workload(warm))
+        # KV-hit parity (BASELINE target #3) is measured over the TIMED
+        # region only: delta of the engines' prefix-cache hit/query token
+        # counters around the workload.
+        h0, q0 = _scrape_prefix_counters(stack.engine_urls)
         records = asyncio.run(run_workload(cfg))
+        h1, q1 = _scrape_prefix_counters(stack.engine_urls)
     finally:
         stack.terminate()
     summary = summarize(records)
@@ -102,6 +153,7 @@ def bench_stack(args) -> dict:
         "value": round(summary["output_tokens_per_s"], 2),
         "summary": summary,
         "avg_prompt_tokens": avg_prompt,
+        "kv_hit_rate": round((h1 - h0) / max(1.0, q1 - q0), 4),
     }
 
 
@@ -121,10 +173,19 @@ async def _run_session(engine, sampling, prompt, ttfts, prompt_toks=None):
     return n_out
 
 
-async def _bench_engine(engine, n_users, rounds, prompt_len, max_tokens):
+async def _bench_engine(engine, n_users, rounds, prompt_len, max_tokens,
+                        history_words=0):
+    from benchmarks.multi_round_qa import synth_text
     from production_stack_tpu.engine.sampling import SamplingParams
 
     system = "You are a helpful assistant. " * max(1, prompt_len // 30)
+
+    def history(u, tag):
+        if history_words <= 0:
+            return ""
+        return (f" user {u} {tag} history: "
+                + synth_text(history_words, seed=u * 131))
+
     sampling = SamplingParams(
         temperature=0.0, max_tokens=max_tokens, ignore_eos=True
     )
@@ -136,13 +197,15 @@ async def _bench_engine(engine, n_users, rounds, prompt_len, max_tokens):
         await asyncio.gather(*[
             _run_session(
                 engine, sampling,
-                system + f"user {u} warmup {w}: please continue the story..",
+                system + history(u, "warmup")
+                + f" user {u} warmup {w}: please continue the story..",
                 ttfts,
             )
             for u in range(n_users)
         ])
     ttfts.clear()
 
+    s0 = engine.stats()
     t_start = time.monotonic()
     total_out = 0
     prompt_toks = []
@@ -150,13 +213,15 @@ async def _bench_engine(engine, n_users, rounds, prompt_len, max_tokens):
         tasks = [
             _run_session(
                 engine, sampling,
-                system + f"user {u} round {r}: please continue the story.",
+                system + history(u, "round")
+                + f" user {u} round {r}: please continue the story.",
                 ttfts, prompt_toks,
             )
             for u in range(n_users)
         ]
         total_out += sum(await asyncio.gather(*tasks))
     elapsed = time.monotonic() - t_start
+    s1 = engine.stats()
     ttfts.sort()
     return {
         "output_tok_s": total_out / elapsed,
@@ -165,6 +230,11 @@ async def _bench_engine(engine, n_users, rounds, prompt_len, max_tokens):
         "elapsed_s": elapsed,
         "avg_prompt_tokens": (
             sum(prompt_toks) / len(prompt_toks) if prompt_toks else 0
+        ),
+        "kv_hit_rate": round(
+            (s1["prefix_cache_hits"] - s0["prefix_cache_hits"])
+            / max(1, s1["prefix_cache_queries"] - s0["prefix_cache_queries"]),
+            4,
         ),
     }
 
@@ -184,6 +254,7 @@ def bench_engine(args) -> dict:
         max_num_batched_tokens=1024,
         num_kv_blocks=None if on_tpu else 2048,
         **({"decode_loop": args.decode_loop} if args.decode_loop else {}),
+        overlap_dispatch=not args.no_overlap,
     )
     engine = ServingEngine(cfg)
 
@@ -192,7 +263,7 @@ def bench_engine(args) -> dict:
         try:
             return await _bench_engine(
                 engine, args.users, args.rounds, args.prompt_len,
-                args.max_tokens,
+                args.max_tokens, history_words=_history_words(args),
             )
         finally:
             await engine.stop()
@@ -203,6 +274,7 @@ def bench_engine(args) -> dict:
         "value": round(res["output_tok_s"], 2),
         "summary": res,
         "avg_prompt_tokens": res["avg_prompt_tokens"],
+        "kv_hit_rate": res["kv_hit_rate"],
     }
 
 
@@ -233,6 +305,24 @@ def main():
     ap.add_argument("--attn-impl", default="auto",
                     choices=["auto", "window", "paged", "xla", "pallas"],
                     help="A/B the decode attention implementation")
+    # Per-user seeded chat history (reference shape: 20k tokens — request
+    # --history-tokens 20000 --max-model-len 32768; the default fits the
+    # default 8192 context). Makes kv_hit_rate a measured quantity.
+    ap.add_argument("--history-tokens", type=int, default=4000,
+                    help="per-user pre-seeded chat-history tokens "
+                         "(clamped to fit --max-model-len; 0 disables)")
+    ap.add_argument("--routing-logic", default="session",
+                    choices=["roundrobin", "session",
+                             "cache_aware_load_balancing"],
+                    help="router routing logic for the stack run (sweep "
+                         "A/B: session vs cache-aware)")
+    ap.add_argument("--num-engines", type=int, default=1,
+                    help="engine subprocesses behind the router; 2-process "
+                         "smoke: --model facebook/opt-125m --num-engines 2 "
+                         "--routing-logic cache_aware_load_balancing")
+    ap.add_argument("--no-overlap", action="store_true",
+                    help="A/B fallback: disable the two-slot prefill/"
+                         "decode dispatch overlap")
     args = ap.parse_args()
 
     # Probe the backend in a SUBPROCESS: in stack mode the parent must not
@@ -267,6 +357,11 @@ def main():
         "p50_ttft_s": round(summary["p50_ttft_s"], 4)
         if summary.get("p50_ttft_s") else None,
         "total_output_tokens": summary["total_output_tokens"],
+        # BASELINE target #3 (KV-hit parity): prefix-cache hit fraction of
+        # queried tokens over the timed region, under the long-history
+        # multi-round workload (--history-tokens).
+        "kv_hit_rate": res.get("kv_hit_rate"),
+        "history_tokens_per_user": args.history_tokens,
         "backend": backend,
     }
     if args.mode == "stack":

@@ -49,6 +49,13 @@ class WorkloadConfig:
     system_prompt_words: int = 120
     question_words: int = 12
     answer_tokens: int = 64
+    # Pre-seeded per-user chat history (words of alternating user/assistant
+    # turns prepended before round 0): the reference workload's users carry
+    # LONG histories (~20k tokens), which is what makes KV/prefix-cache hit
+    # rate a first-class metric — without it every round is a short fresh
+    # prompt and the kv_hit_rate target is unmeasured. Deterministic per
+    # user; 0 disables.
+    history_words: int = 0
     gap_between_users_s: float = 0.0
     session_header: str = "x-user-id"
     api_key: Optional[str] = None
@@ -93,6 +100,31 @@ class UserSession:
         self.cfg = cfg
         self.user_id = user_id
         self.messages = [{"role": "system", "content": system_prompt}]
+        # Long-chat-history seeding: alternating user/assistant turns of
+        # deterministic filler, per-user distinct (so only the system
+        # prompt is cross-user shareable, while a user's OWN history is a
+        # per-session prefix-cache hit on every later round).
+        turn_words = 120
+        seeded = 0
+        turn = 0
+        while seeded < cfg.history_words:
+            self.messages.append({
+                "role": "user",
+                # cfg.tag in the history text keeps a warmup pass's
+                # histories distinct from the timed pass's, so the timed
+                # round 0 pays its history prefill for real and only the
+                # LATER rounds measure the session prefix-cache hit.
+                "content": f"user {user_id} {cfg.tag} history {turn}: "
+                + synth_text(turn_words, seed=user_id * 131 + 2 * turn),
+            })
+            self.messages.append({
+                "role": "assistant",
+                "content": synth_text(
+                    turn_words, seed=user_id * 131 + 2 * turn + 1
+                ),
+            })
+            seeded += 2 * turn_words
+            turn += 1
         self.records: List[RequestRecord] = []
 
     def _question(self, rnd: int) -> str:
@@ -244,6 +276,10 @@ def main() -> int:
     ap.add_argument("--system-prompt-words", type=int, default=120)
     ap.add_argument("--question-words", type=int, default=12)
     ap.add_argument("--answer-tokens", type=int, default=64)
+    ap.add_argument("--history-words", type=int, default=0,
+                    help="per-user pre-seeded chat history (words of "
+                         "alternating user/assistant turns) — the "
+                         "reference's long-history sessions")
     ap.add_argument("--gap-between-users", type=float, default=0.0)
     ap.add_argument("--session-header", default="x-user-id")
     ap.add_argument("--api-key", default=None)
@@ -274,6 +310,7 @@ def main() -> int:
         num_rounds=args.num_rounds,
         system_prompt_words=args.system_prompt_words,
         question_words=args.question_words, answer_tokens=args.answer_tokens,
+        history_words=args.history_words,
         gap_between_users_s=args.gap_between_users,
         session_header=args.session_header, api_key=args.api_key,
         qps=args.qps, time_limit_s=args.time_limit, sharegpt=sharegpt,

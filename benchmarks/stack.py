@@ -42,18 +42,28 @@ def wait_health(url: str, timeout_s: float, proc: subprocess.Popen,
 
 @dataclass
 class StackHandle:
-    engine: subprocess.Popen
+    engines: List[subprocess.Popen]
     router: subprocess.Popen
-    engine_url: str
+    engine_urls: List[str]
     router_url: str
     log_paths: List[str] = field(default_factory=list)
     log_files: List[object] = field(default_factory=list)
 
+    @property
+    def engine(self) -> subprocess.Popen:
+        """First engine process (single-engine callers / run*.sh)."""
+        return self.engines[0]
+
+    @property
+    def engine_url(self) -> str:
+        return self.engine_urls[0]
+
     def terminate(self) -> None:
-        for proc in (self.router, self.engine):
+        procs = [self.router, *self.engines]
+        for proc in procs:
             if proc.poll() is None:
                 proc.terminate()
-        for proc in (self.router, self.engine):
+        for proc in procs:
             try:
                 proc.wait(timeout=15)
             except subprocess.TimeoutExpired:
@@ -73,40 +83,57 @@ def launch_stack(
     served_model: Optional[str] = None,
     startup_timeout_s: float = 1800.0,
     log_dir: str = "/tmp",
+    num_engines: int = 1,
 ) -> StackHandle:
-    """Start engine + router; block until both are healthy."""
-    engine_port = free_port()
+    """Start ``num_engines`` engine pods + the router; block until all are
+    healthy. Multiple engines make the load-balancing routing logics
+    (e.g. cache_aware_load_balancing) actually route — the 2-process
+    opt-125m smoke path in the benchmark sweep."""
     router_port = free_port()
-    engine_url = f"http://127.0.0.1:{engine_port}"
     router_url = f"http://127.0.0.1:{router_port}"
     served = served_model or model
 
-    elog = os.path.join(log_dir, f"pstpu-bench-engine-{engine_port}.log")
-    rlog = os.path.join(log_dir, f"pstpu-bench-router-{router_port}.log")
-
-    engine_cmd = [
-        sys.executable, "-m", "production_stack_tpu.server.api_server",
-        "--model", model, "--port", str(engine_port),
-        *(engine_args or []),
-    ]
-    elog_f = open(elog, "w")
-    engine = subprocess.Popen(
-        engine_cmd, stdout=elog_f, stderr=subprocess.STDOUT,
-    )
+    engines: List[subprocess.Popen] = []
+    engine_urls: List[str] = []
+    log_paths: List[str] = []
+    log_files: List[object] = []
     rlog_f = None
     try:
-        wait_health(f"{engine_url}/health", startup_timeout_s, engine,
-                    "engine")
+        for _ in range(max(1, num_engines)):
+            engine_port = free_port()
+            engine_url = f"http://127.0.0.1:{engine_port}"
+            elog = os.path.join(
+                log_dir, f"pstpu-bench-engine-{engine_port}.log"
+            )
+            elog_f = open(elog, "w")
+            log_paths.append(elog)
+            log_files.append(elog_f)
+            engines.append(subprocess.Popen(
+                [
+                    sys.executable, "-m",
+                    "production_stack_tpu.server.api_server",
+                    "--model", model, "--port", str(engine_port),
+                    *(engine_args or []),
+                ],
+                stdout=elog_f, stderr=subprocess.STDOUT,
+            ))
+            engine_urls.append(engine_url)
+        for engine, engine_url in zip(engines, engine_urls):
+            wait_health(f"{engine_url}/health", startup_timeout_s, engine,
+                        f"engine {engine_url}")
         router_cmd = [
             sys.executable, "-m", "production_stack_tpu.router.app",
             "--port", str(router_port),
             "--service-discovery", "static",
-            "--static-backends", engine_url,
-            "--static-models", served,
+            "--static-backends", ",".join(engine_urls),
+            "--static-models", ",".join([served] * len(engine_urls)),
             "--routing-logic", routing_logic,
             *(router_args or []),
         ]
+        rlog = os.path.join(log_dir, f"pstpu-bench-router-{router_port}.log")
         rlog_f = open(rlog, "w")
+        log_paths.append(rlog)
+        log_files.append(rlog_f)
         router = subprocess.Popen(
             router_cmd, stdout=rlog_f, stderr=subprocess.STDOUT,
         )
@@ -116,13 +143,12 @@ def launch_stack(
             router.kill()
             raise
     except Exception:
-        engine.kill()
-        elog_f.close()
-        if rlog_f is not None:
-            rlog_f.close()
+        for engine in engines:
+            engine.kill()
+        for f in log_files:
+            f.close()
         raise
     return StackHandle(
-        engine=engine, router=router, engine_url=engine_url,
-        router_url=router_url, log_paths=[elog, rlog],
-        log_files=[elog_f, rlog_f],
+        engines=engines, router=router, engine_urls=engine_urls,
+        router_url=router_url, log_paths=log_paths, log_files=log_files,
     )

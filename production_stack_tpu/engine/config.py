@@ -76,6 +76,23 @@ class EngineConfig:
     # round-trip per dispatch on the benched deployment, the single
     # largest serving cost. False restores strict issue-fetch-apply.
     async_pipeline: bool = True
+    # Maximum dispatches outstanding on device at once (the engine loop
+    # fills this many slots before blocking on the oldest fetch). 2 is the
+    # two-slot pipeline: while one dispatch's fetch blocks, the other
+    # executes. Ignored (treated as 1) when async_pipeline is False, and
+    # clamped to 2 by the engine loop (a third outstanding decode could
+    # need token chains from two unapplied dispatches at once — see
+    # runner._chains).
+    pipeline_depth: int = 2
+    # Two-slot prefill/decode overlap: one scheduling round may produce BOTH
+    # a prefill batch and a decode batch, so a fresh prompt's prefill is
+    # issued while a fused decode scan is still in flight (and decode keeps
+    # its cadence during a long prompt's chunk train) instead of the two
+    # kinds strictly alternating through a single slot. Rows finishing
+    # their prompt in an in-flight prefill join decode only after that
+    # prefill's tokens are applied (single-source token chaining). False is
+    # the fallback to the round-5 one-batch-per-round loop.
+    overlap_dispatch: bool = True
     # --- KV offload (LMCache-equivalent; env names mirror the reference chart)
     kv_offload_cpu: bool = field(
         default_factory=lambda: os.environ.get("LMCACHE_LOCAL_CPU", "").lower() == "true"

@@ -15,6 +15,7 @@ This tier replaces the external vLLM engine images of the reference stack
 
 import asyncio
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import AsyncIterator, Dict, List, Optional, Set
 
@@ -128,11 +129,26 @@ class ServingEngine:
         self._loop_task: Optional[asyncio.Task] = None
         self._running = False
         # Optional per-dispatch timeline (production debugging): set
-        # PSTPU_DISPATCH_LOG=/path to append one line per device dispatch.
+        # PSTPU_DISPATCH_LOG=/path to append one line per ISSUE and one per
+        # FETCH of every device dispatch (`issue kind=... step=N ...` /
+        # `fetch kind=... step=N ... ms=...`), so prefill/decode overlap is
+        # directly visible as an issue line landing between another step's
+        # issue and fetch lines.
         import os
 
         _dlog = os.environ.get("PSTPU_DISPATCH_LOG")
         self._dispatch_log = open(_dlog, "a") if _dlog else None
+        # Dispatch-pipeline telemetry (the overlap win must be observable,
+        # not asserted): per-kind dispatch counts, how many fetches ran with
+        # another dispatch still outstanding (overlap), and the cumulative
+        # host-observed gap during which NOTHING was outstanding on device
+        # between two dispatches (pipeline bubble).
+        self.decode_dispatches_total = 0
+        self.prefill_dispatches_total = 0
+        self.fetches_total = 0
+        self.overlapped_fetches_total = 0
+        self.dispatch_gap_seconds_total = 0.0
+        self._last_fetch_done: Optional[float] = None
         # telemetry
         from production_stack_tpu.engine.metrics import (
             RequestLatencyHistograms,
@@ -258,21 +274,41 @@ class ServingEngine:
 
     # ------------------------------------------------------------ engine loop
     async def _run_loop(self) -> None:
-        """Depth-1 pipelined dispatch loop (config.async_pipeline).
+        """Two-slot pipelined dispatch loop (config.async_pipeline /
+        config.pipeline_depth / config.overlap_dispatch).
 
-        Each iteration ISSUES the next dispatch (cheap — enqueue only, no
-        device sync) and only then FETCHES the previous one's tokens, so
-        the blocking device->host round-trip (~100 ms of tunnel RTT per
-        dispatch on the benched deployment — the dominant serving cost)
-        overlaps the new dispatch's execution. The scheduler's state is
-        advanced speculatively at issue (advance_at_issue) and tokens are
-        delivered at fetch (apply_results); rows that finish or get
+        Each iteration FILLS the free dispatch slots — issuing is cheap
+        (enqueue only, no device sync) — and only then FETCHES the oldest
+        outstanding dispatch's tokens, so the blocking device->host
+        round-trip (~100 ms of tunnel RTT per dispatch on the benched
+        deployment — the dominant serving cost) overlaps the newer
+        dispatches' execution. With overlap_dispatch the two slots can hold
+        DIFFERENT kinds at once: a scheduling round produces a prefill
+        batch and a decode batch when both are admissible, so a fresh
+        prompt's prefill is issued while a fused decode scan is still in
+        flight (it no longer waits out the scan behind a single slot) and
+        decode keeps its cadence through a long prompt's chunk train
+        (Sarathi-style stall-free batching).
+
+        The scheduler's state is advanced speculatively at issue
+        (advance_at_issue) and tokens are delivered at fetch
+        (apply_results), strictly in issue order; rows that finish or get
         preempted while a dispatch is in flight simply discard its tokens
-        for them (epoch check), and the next dispatch's start tokens ride
-        the device-resident chain vector, never the host."""
+        for them (epoch check), and a chained dispatch's start tokens ride
+        ONE device-resident last-token vector (fresh prefill rows join
+        decode only after their prefill's apply, so a decode never needs
+        chains from two in-flight dispatches)."""
         loop = asyncio.get_running_loop()
-        in_flight = None  # (batch, DispatchHandle)
-        pipeline = self.config.async_pipeline
+        cfg = self.config
+        # Clamped to 2: at depth >= 3 a third decode could need start-token
+        # chains from TWO unapplied decode dispatches at once (a row the
+        # window budget skipped in the middle one), breaking the
+        # single-source invariant — and a device queue of 2 already hides
+        # the host round-trip.
+        depth = max(1, min(2, cfg.pipeline_depth)) if cfg.async_pipeline \
+            else 1
+        overlap = cfg.overlap_dispatch and depth >= 2
+        in_flight: deque = deque()  # (batch, step_id, DispatchHandle) FIFO
 
         def abort_batch(batch):
             for seq in batch.seqs:
@@ -280,26 +316,37 @@ class ServingEngine:
                 if aborted is not None:
                     self._process_output(aborted)
 
-        async def apply_in_flight():
-            nonlocal in_flight
-            if in_flight is None:
+        def dlog(event, batch, step, extra=""):
+            if self._dispatch_log is None:
                 return
-            batch, handle = in_flight
-            in_flight = None
+            kt = (batch.num_steps if batch.kind == "decode"
+                  else max(batch.chunk_lens))
+            self._dispatch_log.write(
+                f"{event} kind={batch.kind} step={step} "
+                f"rows={len(batch.seqs)} kt={kt} "
+                f"inflight={len(in_flight)} t={time.monotonic():.6f}"
+                f"{extra}\n"
+            )
+            self._dispatch_log.flush()
+
+        async def apply_oldest():
+            batch, step, handle = in_flight.popleft()
+            self.fetches_total += 1
+            if in_flight:
+                # Another dispatch executes while this fetch blocks: the
+                # round-trip is hidden.
+                self.overlapped_fetches_total += 1
             try:
                 tokens, lps = await loop.run_in_executor(None, handle.fetch)
             except Exception:  # noqa: BLE001 — engine loop must survive
                 logger.exception("Dispatch fetch failed; aborting batch")
                 abort_batch(batch)
+                self._last_fetch_done = time.monotonic()
                 return
-            if self._dispatch_log is not None:
-                self._dispatch_log.write(
-                    f"{batch.kind} rows={len(batch.seqs)} "
-                    f"kt={batch.num_steps if batch.kind == 'decode' else max(batch.chunk_lens)} "
-                    f"ms={(time.monotonic() - handle.issue_time) * 1000:.1f}\n"
-                )
-                self._dispatch_log.flush()
-            self.last_step_time = time.monotonic()
+            dlog("fetch", batch, step, extra=(
+                f" ms={(time.monotonic() - handle.issue_time) * 1000:.1f}"
+            ))
+            self.last_step_time = self._last_fetch_done = time.monotonic()
             produced, accepted = self.scheduler.apply_results(
                 batch, tokens, lps
             )
@@ -307,60 +354,87 @@ class ServingEngine:
             for seq in produced:
                 self._process_output(seq)
 
+        async def drain():
+            while in_flight:
+                await apply_oldest()
+
+        def next_batch():
+            if not overlap:
+                return self.scheduler.schedule()
+            kinds = {b.kind for b, _, _ in in_flight}
+            # Balance the slots across kinds: with a prefill already in
+            # flight, decode gets the free slot first (its streams must not
+            # stall behind a chunk train); otherwise prefill-priority as
+            # ever (TTFT). A single active kind still fills both slots.
+            return self.scheduler.schedule(
+                prefer_decode=("prefill" in kinds and "decode" not in kinds)
+            )
+
         while self._running:
             self._apply_pending_aborts()
-            batch = self.scheduler.schedule()
-            if batch is None:
-                if in_flight is not None:
-                    # Applying may finish rows and free blocks, unblocking
-                    # admission — re-schedule right after.
-                    await apply_in_flight()
-                    continue
-                self._new_work.clear()
-                # Idle: drop the persistent decode window so its (up to
-                # window-budget-sized) device buffers don't pin HBM.
-                self.runner._win_cache = None
-                if not self.scheduler.has_work():
-                    try:
-                        await asyncio.wait_for(self._new_work.wait(), timeout=1.0)
-                    except asyncio.TimeoutError:
-                        pass
+            issue_failed = False
+            while len(in_flight) < depth and not issue_failed:
+                batch = next_batch()
+                if batch is None:
+                    break
+                # Penalty counts are built from APPLIED tokens; drain the
+                # pipeline first so they are exact.
+                if in_flight and any(
+                    s.sampling.presence_penalty or s.sampling.frequency_penalty
+                    for s in batch.seqs
+                ):
+                    await drain()
+                step = self._step_counter
+                self._step_counter += 1
+                try:
+                    # Issue in the executor: normally enqueue-only (~ms),
+                    # but a cold shape family compiles for seconds and a
+                    # penalty batch builds [b, vocab] counts — neither may
+                    # freeze the event loop (SSE, health). Runner state
+                    # stays effectively single-threaded: issue and fetch
+                    # are each awaited before the next runner call.
+                    handle = await loop.run_in_executor(
+                        None, self.runner.execute_async, batch, step
+                    )
+                except Exception:  # noqa: BLE001 — engine loop must survive
+                    logger.exception("Dispatch issue failed; aborting batch")
+                    abort_batch(batch)
+                    issue_failed = True
+                    break
+                if not in_flight and self._last_fetch_done is not None:
+                    self.dispatch_gap_seconds_total += (
+                        time.monotonic() - self._last_fetch_done
+                    )
+                if batch.kind == "decode":
+                    self.decode_dispatches_total += 1
                 else:
-                    # Work exists but nothing schedulable (pool starved by
-                    # in-flight requests) — yield and retry.
-                    await asyncio.sleep(0.001)
+                    self.prefill_dispatches_total += 1
+                self.scheduler.advance_at_issue(batch)
+                dlog("issue", batch, step)
+                in_flight.append((batch, step, handle))
+            if in_flight:
+                # Applying may finish rows and free blocks, unblocking
+                # admission — the next iteration re-schedules right after.
+                await apply_oldest()
+                await asyncio.sleep(0)
                 continue
-            # Penalty counts are built from APPLIED tokens; drain the
-            # pipeline first so they are exact.
-            if in_flight is not None and any(
-                s.sampling.presence_penalty or s.sampling.frequency_penalty
-                for s in batch.seqs
-            ):
-                await apply_in_flight()
-            step = self._step_counter
-            self._step_counter += 1
-            try:
-                # Issue in the executor: normally enqueue-only (~ms), but
-                # a cold shape family compiles for seconds and a penalty
-                # batch builds [b, vocab] counts — neither may freeze the
-                # event loop (SSE, health). Runner state stays effectively
-                # single-threaded: issue and fetch are each awaited before
-                # the next runner call.
-                handle = await loop.run_in_executor(
-                    None, self.runner.execute_async, batch, step
-                )
-            except Exception:  # noqa: BLE001 — engine loop must survive
-                logger.exception("Dispatch issue failed; aborting batch")
-                abort_batch(batch)
+            if issue_failed:
                 continue
-            self.scheduler.advance_at_issue(batch)
-            await apply_in_flight()
-            in_flight = (batch, handle)
-            if not pipeline:
-                await apply_in_flight()
-            await asyncio.sleep(0)
+            self._new_work.clear()
+            # Idle: drop the persistent decode window so its (up to
+            # window-budget-sized) device buffers don't pin HBM.
+            self.runner._win_cache = None
+            if not self.scheduler.has_work():
+                try:
+                    await asyncio.wait_for(self._new_work.wait(), timeout=1.0)
+                except asyncio.TimeoutError:
+                    pass
+            else:
+                # Work exists but nothing schedulable (pool starved by
+                # in-flight requests) — yield and retry.
+                await asyncio.sleep(0.001)
         # Drain on shutdown so no accepted tokens are lost.
-        await apply_in_flight()
+        await drain()
 
     def _apply_pending_aborts(self) -> None:
         while self._pending_aborts:
@@ -477,4 +551,11 @@ class ServingEngine:
             "num_preemptions": self.scheduler.num_preemptions_total,
             "prompt_tokens_total": self.prompt_tokens_total,
             "generation_tokens_total": self.generation_tokens_total,
+            "decode_dispatches_total": self.decode_dispatches_total,
+            "prefill_dispatches_total": self.prefill_dispatches_total,
+            "dispatch_overlap_ratio": (
+                self.overlapped_fetches_total / self.fetches_total
+                if self.fetches_total else 0.0
+            ),
+            "dispatch_gap_seconds_total": self.dispatch_gap_seconds_total,
         }

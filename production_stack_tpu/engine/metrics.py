@@ -64,6 +64,23 @@ class EngineMetricsCollector(Collector):
         yield gauge("pstpu:kv_offload_blocks",
                     "KV blocks resident in the host offload pool",
                     eng.offload_blocks_resident)
+        # Dispatch-pipeline overlap telemetry (two-slot prefill/decode
+        # overlap, engine.py:_run_loop): the overlap win is observable.
+        yield counter("pstpu:decode_dispatches_total",
+                      "Fused decode dispatches issued",
+                      eng.decode_dispatches_total)
+        yield counter("pstpu:prefill_dispatches_total",
+                      "Prefill chunk dispatches issued",
+                      eng.prefill_dispatches_total)
+        yield gauge("pstpu:dispatch_overlap_ratio",
+                    "Fraction of dispatch fetches that ran with another "
+                    "dispatch still outstanding (round-trip hidden)",
+                    (eng.overlapped_fetches_total / eng.fetches_total
+                     if eng.fetches_total else 0.0))
+        yield counter("pstpu:dispatch_gap_seconds_total",
+                      "Cumulative host-observed time with NO dispatch "
+                      "outstanding between two dispatches (pipeline bubble)",
+                      eng.dispatch_gap_seconds_total)
 
 
 # vLLM's bucket boundaries for the two request-latency histograms the

@@ -241,13 +241,24 @@ class ModelRunner:
         # live KV every dispatch (~80-100 ms fixed cost at 16x2k-token rows
         # on a v5e — r3 profiling). {ids, b, mb, end[], win=(k, v)}.
         self._win_cache = None
-        # Token-chain state: the previous dispatch's device-resident
-        # last-token vector + row mapping ({request_id: row index}) and
-        # preemption epochs, so the next decode dispatch can start from
-        # tokens the host has not fetched yet (pipelined engine loop).
+        # Token-chain state: recent dispatches' device-resident last-token
+        # vectors + row mappings ({request_id: row index}) and preemption
+        # epochs, so the next decode dispatch can start from tokens the
+        # host has not fetched yet (pipelined engine loop). A LIST (newest
+        # first) because the two-slot overlap loop can interleave kinds —
+        # e.g. decode D1, prefill P1, decode D2: D2's rows chain from D1's
+        # vector even though P1's entry is newer. Any one decode still
+        # chains from a SINGLE source vector (the scheduler keeps
+        # fresh-prefill rows out of decode until their tokens are applied);
+        # _issue_decode enforces that invariant.
         self._b_max = _bucket(config.max_num_seqs, 1,
                               max(1, config.max_num_seqs))
-        self._chain = None
+        self._chains: List[Dict] = []
+        # Entries only matter while their dispatch (or a row's last token)
+        # is unapplied; with at most pipeline_depth dispatches outstanding,
+        # the newest depth+1 token-producing entries cover every chainable
+        # row.
+        self._max_chains = max(2, getattr(config, "pipeline_depth", 2))
         # COMMITTED + mesh-replicated, so its pjit cache key matches the
         # chain vectors dispatches return (an uncommitted jnp.zeros would
         # key a separate executable variant — the committed/uncommitted
@@ -628,30 +639,43 @@ class ModelRunner:
              if s.sampling.logprobs is not None),
             default=0,
         )
-        chain = self._chain
         sc[11, :] = -1
+        chain_entry = None  # the ONE device vector this dispatch chains from
         for i, s in enumerate(seqs):
             pos = s.num_computed_tokens
             # Token chaining: a row whose last sampled token still sits in
-            # the previous dispatch's device buffer (unapplied — the
-            # pipelined engine issues before fetching) reads it ON DEVICE;
-            # all other rows have fully-applied host tokens.
-            src = -1
-            if chain is not None:
-                src = chain["row"].get(s.request_id, -1)
-                if src >= 0 and chain["epoch"][s.request_id] != \
-                        s.num_preemptions:
-                    src = -1
-            if src >= 0:
-                sc[11, i] = src
+            # an in-flight dispatch's device buffer (unapplied — the
+            # pipelined engine issues before fetching) reads it ON DEVICE
+            # from that dispatch's last-token vector; rows with
+            # fully-applied host tokens take the packed tokens0. All
+            # chained rows must resolve to the SAME source dispatch — the
+            # scheduler guarantees it (fresh prefill rows wait for apply;
+            # at most one token-producing dispatch is unapplied at issue).
+            if pos < len(s.all_token_ids):
+                sc[0, i] = s.all_token_ids[pos]
             else:
-                if pos >= len(s.all_token_ids):
+                src, src_entry = -1, None
+                for entry in self._chains:  # newest first
+                    r = entry["row"].get(s.request_id, -1)
+                    if r >= 0 and entry["epoch"][s.request_id] == \
+                            s.num_preemptions:
+                        src, src_entry = r, entry
+                        break
+                if src < 0:
                     raise RuntimeError(
                         f"row {s.request_id}: token at pos {pos} neither "
-                        f"applied on host nor chainable from the previous "
+                        f"applied on host nor chainable from a recent "
                         f"dispatch (pipeline invariant breach)"
                     )
-                sc[0, i] = s.all_token_ids[pos]
+                if chain_entry is None:
+                    chain_entry = src_entry
+                elif chain_entry is not src_entry:
+                    raise RuntimeError(
+                        f"row {s.request_id}: decode batch chains start "
+                        f"tokens from two different in-flight dispatches "
+                        f"(overlap single-source invariant breach)"
+                    )
+                sc[11, i] = src
             sc[1, i] = pos
             sc[2, i] = batch.decode_steps[i]
             u32[3, i] = _seed_base(s)
@@ -704,7 +728,9 @@ class ModelRunner:
             wk = jnp.zeros((1, 1, 1, 1, 1), self.dtype)
             wv = jnp.zeros((1, 1, 1, 1, 1), self.dtype)
 
-        prev_last = chain["last"] if chain is not None else self._zero_last
+        prev_last = (
+            chain_entry["last"] if chain_entry is not None else self._zero_last
+        )
         (toks_all, self.kv_k, self.kv_v, wk2, wv2, lp_c, lp_t, lp_i,
          last_token) = self._decode(
             self.params, jnp.asarray(packed), self.kv_k, self.kv_v,
@@ -721,11 +747,11 @@ class ModelRunner:
                 ],
                 "win": (wk2, wv2),
             }
-        self._chain = {
+        self._push_chain({
             "last": last_token,
             "row": {s.request_id: i for i, s in enumerate(seqs)},
             "epoch": {s.request_id: s.num_preemptions for s in seqs},
-        }
+        })
         steps = list(batch.decode_steps)
         n = len(seqs)
 
@@ -946,17 +972,19 @@ class ModelRunner:
                 has_penalties=has_penalties, logprobs_k=logprobs_k,
             )
         # Final rows' sampled tokens are chainable by the next decode
-        # dispatch without a host roundtrip.
-        self._chain = {
-            "last": last_token,
-            "row": {
-                s.request_id: i for i, s in enumerate(seqs) if finals[i]
-            },
-            "epoch": {
-                s.request_id: s.num_preemptions
-                for i, s in enumerate(seqs) if finals[i]
-            },
-        }
+        # dispatch without a host roundtrip. Non-final chunks produce no
+        # tokens — no entry, so they never evict a live decode chain.
+        if any(finals):
+            self._push_chain({
+                "last": last_token,
+                "row": {
+                    s.request_id: i for i, s in enumerate(seqs) if finals[i]
+                },
+                "epoch": {
+                    s.request_id: s.num_preemptions
+                    for i, s in enumerate(seqs) if finals[i]
+                },
+            })
 
         def fetch():
             if not any(finals):
@@ -974,6 +1002,14 @@ class ModelRunner:
             return tokens, lp
 
         return DispatchHandle(fetch)
+
+    # ------------------------------------------------------------ token chain
+    def _push_chain(self, entry: Dict) -> None:
+        """Record a token-producing dispatch's device-resident last-token
+        vector (newest first, bounded): later decodes chain start tokens
+        from it until the dispatch's results reach the host."""
+        self._chains.insert(0, entry)
+        del self._chains[self._max_chains:]
 
     # ---------------------------------------------------------------- execute
     def execute_async(self, batch: ScheduledBatch,

@@ -87,6 +87,12 @@ class Sequence:
     # is in the pool and their seeds consumed, but the ids are not yet on
     # the host. num_computed_tokens already includes them.
     inflight_steps: int = 0
+    # True while the FINAL chunk of this row's prefill is issued but not yet
+    # applied: the row must not join a decode batch until then, so a decode
+    # never needs token chains from two different in-flight dispatches
+    # (overlap_dispatch invariant — the packed chain_src indexes ONE
+    # prev-last vector).
+    pending_prefill_apply: bool = False
     # Aligned with output_token_ids when sampling.logprobs is set: one
     # (chosen_logprob, [(token_id, logprob), ...]) per accepted token.
     output_logprobs: List = field(default_factory=list)
@@ -233,7 +239,16 @@ class Scheduler:
         return bool(self.waiting or self.running)
 
     # -------------------------------------------------------------- schedule
-    def schedule(self) -> Optional[ScheduledBatch]:
+    def schedule(self, prefer_decode: bool = False) -> Optional[ScheduledBatch]:
+        """One admissible batch. Default order is prefill-first (TTFT
+        priority); ``prefer_decode`` inverts it — the overlap engine loop
+        uses it to keep decode cadence while a prefill dispatch is already
+        in flight in the other slot (Sarathi-style stall-free batching)."""
+        if prefer_decode:
+            batch = self._schedule_decode()
+            if batch is not None:
+                return batch
+            return self._try_schedule_prefill()
         batch = self._try_schedule_prefill()
         if batch is not None:
             return batch
@@ -371,6 +386,13 @@ class Scheduler:
             if seq not in self.running:
                 # Preempted by an earlier iteration of this same pass.
                 continue
+            if seq.pending_prefill_apply:
+                # The row's first token still sits in an in-flight prefill
+                # dispatch's device buffer; decoding it now could force a
+                # batch to chain start tokens from two different dispatches
+                # (overlap_dispatch single-source invariant). It joins the
+                # dispatch after that prefill's apply.
+                continue
             # Positions written this dispatch: pos .. pos+want-1. `want` is
             # capped by model-length capacity and the request's remaining
             # token budget (counting in-flight unapplied tokens) so the
@@ -450,6 +472,11 @@ class Scheduler:
         # possible (prefill just ran), and capping on an INADMISSIBLE
         # backlog only quadruples per-dispatch overhead at saturation
         # (r5 review).
+        # (Under overlap_dispatch a prefill-final row joins decode only
+        # after its prefill token is APPLIED — output non-empty — so this
+        # cap rarely fires there; its TTFT purpose is served by the overlap
+        # itself: the first token is delivered at prefill apply, not after
+        # the first fused decode scan.)
         if any(not s.output_token_ids for s in scheduled):
             max_k = min(max_k, INTERACTIVE_DECODE_STEPS)
         # K is PINNED at the graded cap, not bucketed by the largest per-row
@@ -492,6 +519,7 @@ class Scheduler:
         # rows whose preemption epoch changed); recompute-by-prefill
         # regenerates them deterministically from the same seeds.
         seq.inflight_steps = 0
+        seq.pending_prefill_apply = False
         seq._prev_hash = seq.hash_seed
         seq._num_hashed_blocks = 0
         seq.status = SequenceStatus.WAITING
@@ -517,8 +545,11 @@ class Scheduler:
                 batch.finals.append(final)
                 if final:
                     # Prompt complete: the sampled (in-flight) next token
-                    # moves the row to RUNNING for decode scheduling.
+                    # moves the row to RUNNING for decode scheduling. It is
+                    # decode-ineligible until this dispatch's apply (see
+                    # pending_prefill_apply).
                     seq.inflight_steps += 1
+                    seq.pending_prefill_apply = True
                     self.running.append(seq)
                 else:
                     # More chunks to go; requeue at the front (order kept).
@@ -556,6 +587,11 @@ class Scheduler:
         accepted = 0
         if batch.kind == "prefill":
             for idx, seq in enumerate(batch.seqs):
+                if batch.finals[idx] and \
+                        seq.num_preemptions == batch.epochs[idx]:
+                    # This batch set the flag at issue; a preempted-since
+                    # row's NEW prefill manages its own flag (epoch guard).
+                    seq.pending_prefill_apply = False
                 if not self._apply_valid(seq, batch.epochs[idx]):
                     continue
                 self._register_full_blocks(seq)

@@ -332,13 +332,13 @@ def paged_flash_decode_stats_tp(
     """
     from jax.sharding import PartitionSpec as P
 
-    from production_stack_tpu.parallel.mesh import AXIS_TP
+    from production_stack_tpu.parallel.mesh import AXIS_TP, shard_map
 
     fn = functools.partial(
         paged_flash_decode_stats,
         block_size=block_size, scale=scale, interpret=interpret,
     )
-    return jax.shard_map(
+    return shard_map(
         fn,
         mesh=mesh,
         in_specs=(

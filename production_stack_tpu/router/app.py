@@ -37,7 +37,6 @@ from production_stack_tpu.router.feature_gates import (
 from production_stack_tpu.router.files_service import initialize_storage
 from production_stack_tpu.router.request_service import (
     _error,
-    proxy_request,
     resilient_json_request,
     route_general_request,
 )

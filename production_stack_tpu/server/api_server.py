@@ -889,6 +889,8 @@ def build_engine_from_args(args: argparse.Namespace) -> ServingEngine:
            if args.decode_loop is not None else {}),
         attn_impl=args.attn_impl,
         enable_warmup=not args.no_warmup,
+        overlap_dispatch=not args.no_overlap_dispatch,
+        pipeline_depth=args.pipeline_depth,
         lora_modules=_parse_lora_modules(args.lora_modules),
     )
     return ServingEngine(cfg)
@@ -922,6 +924,14 @@ def parse_args(argv=None) -> argparse.Namespace:
                    choices=["auto", "window", "paged", "xla", "pallas"])
     p.add_argument("--no-warmup", action="store_true",
                    help="Skip AOT warmup compilation at startup")
+    p.add_argument("--no-overlap-dispatch", action="store_true",
+                   help="Fallback: disable the two-slot prefill/decode "
+                        "dispatch overlap (one batch kind per scheduling "
+                        "round, as in round 5)")
+    p.add_argument("--pipeline-depth", type=int, default=2,
+                   help="Max dispatches outstanding on device at once "
+                        "(EngineConfig.pipeline_depth; 1 = no pipelining; "
+                        "clamped to 2)")
     p.add_argument("--lora-modules", nargs="*", default=[],
                    metavar="NAME=PATH",
                    help="LoRA adapters to serve (vLLM convention): "

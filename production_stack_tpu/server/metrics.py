@@ -15,7 +15,12 @@ if TYPE_CHECKING:
 
 
 def render_engine_metrics(engine: "ServingEngine", model_name: str) -> str:
-    s = engine.stats()
+    s = dict(engine.stats())
+    # Dispatch-pipeline telemetry keys default to 0 so protocol-faithful
+    # fakes (tests) that predate them still render.
+    for key in ("decode_dispatches_total", "prefill_dispatches_total",
+                "dispatch_overlap_ratio", "dispatch_gap_seconds_total"):
+        s.setdefault(key, 0)
     label = f'{{model_name="{model_name}"}}'
     lines = [
         "# HELP vllm:num_requests_running Running requests",
@@ -42,6 +47,27 @@ def render_engine_metrics(engine: "ServingEngine", model_name: str) -> str:
         "# HELP vllm:generation_tokens_total Generated tokens",
         "# TYPE vllm:generation_tokens_total counter",
         f"vllm:generation_tokens_total{label} {s['generation_tokens_total']}",
+        # Two-slot dispatch-pipeline telemetry (engine.py:_run_loop): the
+        # prefill/decode overlap win is observable, not asserted.
+        "# HELP pstpu:decode_dispatches_total Fused decode dispatches issued",
+        "# TYPE pstpu:decode_dispatches_total counter",
+        f"pstpu:decode_dispatches_total{label} "
+        f"{s['decode_dispatches_total']}",
+        "# HELP pstpu:prefill_dispatches_total Prefill chunk dispatches "
+        "issued",
+        "# TYPE pstpu:prefill_dispatches_total counter",
+        f"pstpu:prefill_dispatches_total{label} "
+        f"{s['prefill_dispatches_total']}",
+        "# HELP pstpu:dispatch_overlap_ratio Fraction of dispatch fetches "
+        "with another dispatch still outstanding",
+        "# TYPE pstpu:dispatch_overlap_ratio gauge",
+        f"pstpu:dispatch_overlap_ratio{label} "
+        f"{s['dispatch_overlap_ratio']:.6f}",
+        "# HELP pstpu:dispatch_gap_seconds_total Host-observed seconds with "
+        "no dispatch outstanding between dispatches",
+        "# TYPE pstpu:dispatch_gap_seconds_total counter",
+        f"pstpu:dispatch_gap_seconds_total{label} "
+        f"{s['dispatch_gap_seconds_total']:.6f}",
     ]
     # TTFT / e2e latency distributions (the reference dashboard's two
     # distribution panels query these bucket series).

@@ -3,6 +3,7 @@ output, plot.py curve generation, ShareGPT preprocessing — the reference
 benchmarks/multi-round-qa/{run.sh,plot.py,data_preprocessing.py}
 procedure, driven here against the protocol-faithful fake engine."""
 
+import argparse
 import json
 import os
 import subprocess
@@ -11,6 +12,7 @@ import sys
 from aiohttp.test_utils import TestServer
 
 from benchmarks.multi_round_qa import (
+    UserSession,
     WorkloadConfig,
     run_workload,
     summarize,
@@ -124,3 +126,109 @@ def test_sharegpt_preprocessing_and_questions(tmp_path):
     assert "what is a tpu" in s._question(0)
     assert "how fast is it" in s._question(1)
     assert "round 2" in s._question(2)  # exhausted -> synthetic fallback
+
+
+def test_history_seeding_in_user_session():
+    """Long-chat-history fidelity (BASELINE KV-hit workload): a session
+    seeds alternating user/assistant turns totalling ~history_words before
+    round 0, per-user + per-tag distinct."""
+    cfg = WorkloadConfig(history_words=480)
+    s = UserSession(cfg, 3, "sys")
+    roles = [m["role"] for m in s.messages]
+    assert roles[0] == "system"
+    hist = roles[1:]
+    assert hist and all(r == "user" for r in hist[::2])
+    assert all(r == "assistant" for r in hist[1::2])
+    total = sum(len(m["content"].split()) for m in s.messages[1:])
+    assert total >= 480
+    # A warmup pass's history text differs, so the timed pass's history
+    # prefill is NOT pre-warmed in the prefix cache.
+    warm = UserSession(WorkloadConfig(history_words=480, tag="warmup"),
+                       3, "sys")
+    assert warm.messages[1]["content"] != s.messages[1]["content"]
+    # Another user's history differs too (only the system prompt shares).
+    other = UserSession(cfg, 4, "sys")
+    assert other.messages[1]["content"] != s.messages[1]["content"]
+    # Disabled by default.
+    assert len(UserSession(WorkloadConfig(), 3, "sys").messages) == 1
+
+
+def test_bench_stack_routing_and_kv_hit_wiring(monkeypatch):
+    """bench.py --routing-logic/--num-engines/--history-tokens reach
+    launch_stack and the workload, and kv_hit_rate is the timed-region
+    delta of the engines' prefix-cache counters."""
+    import bench
+    import benchmarks.multi_round_qa as mrq
+    import benchmarks.stack as stack_mod
+
+    calls = {}
+
+    class FakeStack:
+        router_url = "http://router"
+        engine_urls = ["http://e1", "http://e2"]
+        log_paths = []
+
+        def terminate(self):
+            calls["terminated"] = True
+
+    def fake_launch(model, **kw):
+        calls["model"] = model
+        calls.update(kw)
+        return FakeStack()
+
+    recs = [
+        mrq.RequestRecord(user=0, round=r, launch_time=0.0, ttft=0.1,
+                          finish_time=1.0, prompt_tokens=100,
+                          generation_tokens=8)
+        for r in range(2)
+    ]
+
+    async def fake_run(cfg):
+        calls.setdefault("workloads", []).append(cfg)
+        return recs
+
+    scrapes = iter([(100.0, 1000.0), (600.0, 2000.0)])
+
+    def fake_scrape(urls):
+        calls.setdefault("scraped", []).append(list(urls))
+        return next(scrapes)
+
+    monkeypatch.setattr(stack_mod, "launch_stack", fake_launch)
+    monkeypatch.setattr(mrq, "run_workload", fake_run)
+    monkeypatch.setattr(bench, "_scrape_prefix_counters", fake_scrape)
+
+    args = argparse.Namespace(
+        model="facebook/opt-125m", users=2, rounds=2, prompt_len=15,
+        max_tokens=8, max_model_len=2048, attn_impl="auto",
+        decode_loop=None, no_overlap=False,
+        routing_logic="cache_aware_load_balancing", num_engines=2,
+        history_tokens=500,
+    )
+    res = bench.bench_stack(args)
+    assert calls["routing_logic"] == "cache_aware_load_balancing"
+    assert calls["num_engines"] == 2
+    assert calls["terminated"]
+    # (600-100)/(2000-1000): the warmup pass's cache traffic is excluded.
+    assert res["kv_hit_rate"] == 0.5
+    assert calls["scraped"] == [["http://e1", "http://e2"]] * 2
+    warm_cfg, timed_cfg = calls["workloads"]
+    assert warm_cfg.tag == "warmup" and timed_cfg.tag == "round"
+    assert timed_cfg.history_words > 0
+    assert timed_cfg.history_words == warm_cfg.history_words
+
+
+def test_history_words_clamped_to_model_len():
+    import bench
+
+    args = argparse.Namespace(prompt_len=150, rounds=4, max_tokens=100,
+                              max_model_len=8192, history_tokens=20000)
+    words = bench._history_words(args)
+    # Clamped: 20k tokens cannot fit an 8192 context...
+    assert 0 < words < 20000 * bench.WORDS_PER_TOKEN
+    # ...but fits a 32k one un-clamped.
+    args.max_model_len = 32768
+    assert bench._history_words(args) == int(
+        20000 * bench.WORDS_PER_TOKEN
+    )
+    args.history_tokens = 0
+    assert bench._history_words(args) == 0

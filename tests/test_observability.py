@@ -42,6 +42,9 @@ def _exported_series():
                 "kv_cache_usage": 0.5, "prefix_cache_hits": 3,
                 "prefix_cache_queries": 7, "num_preemptions": 0,
                 "prompt_tokens_total": 10, "generation_tokens_total": 20,
+                "decode_dispatches_total": 5, "prefill_dispatches_total": 2,
+                "dispatch_overlap_ratio": 0.5,
+                "dispatch_gap_seconds_total": 0.1,
             }
 
     text = render_engine_metrics(_FakeEngine(), "m")

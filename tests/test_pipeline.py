@@ -1,8 +1,10 @@
-"""Pipelined engine loop (config.async_pipeline): issue-before-fetch with
-device-chained start tokens must be SEMANTICALLY INVISIBLE — identical
-tokens, finish reasons, stop handling, and usage as the strict loop, for
-every sampling mode. (The pipeline hides the ~100 ms blocking device->host
-sync per dispatch that dominated serving on the benched deployment.)"""
+"""Pipelined engine loop (config.async_pipeline / config.overlap_dispatch):
+issue-before-fetch with device-chained start tokens AND the two-slot
+prefill/decode overlap must be SEMANTICALLY INVISIBLE — identical tokens,
+finish reasons, stop handling, and usage as the strict loop, for every
+sampling mode. (The pipeline hides the ~100 ms blocking device->host sync
+per dispatch that dominated serving on the benched deployment; the overlap
+slots keep decode running through prefill chunk trains and vice versa.)"""
 
 import asyncio
 
@@ -11,6 +13,15 @@ import pytest
 from production_stack_tpu.engine.config import EngineConfig
 from production_stack_tpu.engine.engine import ServingEngine
 from production_stack_tpu.engine.sampling import SamplingParams
+
+# The three loop modes every parity workload must agree across: strict
+# issue-fetch-apply, the depth-2 pipeline without kind overlap (round 5),
+# and the two-slot prefill/decode overlap (default).
+LOOP_MODES = (
+    ("strict", dict(async_pipeline=False, overlap_dispatch=False)),
+    ("pipeline", dict(async_pipeline=True, overlap_dispatch=False)),
+    ("overlap", dict(async_pipeline=True, overlap_dispatch=True)),
+)
 
 
 def _cfg(pipeline: bool, **over):
@@ -66,20 +77,21 @@ async def _drive(engine):
 @pytest.mark.asyncio
 async def test_pipeline_matches_strict_loop():
     outs = {}
-    for pipeline in (False, True):
-        engine = ServingEngine(_cfg(pipeline))
+    for name, over in LOOP_MODES:
+        engine = ServingEngine(_cfg(True, **over))
         await engine.start()
         try:
-            outs[pipeline] = await _drive(engine)
+            outs[name] = await _drive(engine)
             stats = engine.stats()
             assert stats["num_requests_running"] == 0
             assert stats["num_requests_waiting"] == 0
         finally:
             await engine.stop()
-    assert outs[True] == outs[False]
-    toks, _, reason = outs[True]["a"]
+    assert outs["overlap"] == outs["strict"]
+    assert outs["pipeline"] == outs["strict"]
+    toks, _, reason = outs["overlap"]["a"]
     assert len(toks) == 21 and reason == "length"
-    assert outs[True]["stop"][2] == "stop"
+    assert outs["overlap"]["stop"][2] == "stop"
 
 
 @pytest.mark.asyncio
@@ -117,14 +129,12 @@ async def test_pipeline_abort_mid_flight():
 
 @pytest.mark.asyncio
 async def test_pipeline_preemption_discards_inflight():
-    """Preemption under pool pressure while dispatches are in flight:
-    epochs invalidate the stale results and recompute reproduces the same
-    tokens (deterministic seeds)."""
-    cfg = _cfg(True, num_kv_blocks=48, max_model_len=256,
-               max_num_seqs=3, max_num_batched_tokens=64)
-    engine = ServingEngine(cfg)
-    await engine.start()
-    try:
+    """Preemption under pool pressure while (up to two) dispatches are in
+    flight: epochs invalidate the stale results and recompute reproduces
+    the same tokens (deterministic seeds) — in every loop mode, including
+    the two-slot overlap where the preemption can land while a decode AND
+    a prefill are both outstanding."""
+    async def run_all(engine):
         async def run(i):
             toks = []
             async for o in engine.generate(
@@ -134,27 +144,75 @@ async def test_pipeline_preemption_discards_inflight():
             ):
                 toks = o.token_ids
             return toks
-        many = await asyncio.gather(*[run(i) for i in range(3)])
-        assert all(len(t) == 40 for t in many)
+        return await asyncio.gather(*[run(i) for i in range(3)])
 
-        # determinism across a run with vs without pressure
-        engine2 = ServingEngine(_cfg(True, max_num_seqs=3,
-                                     max_model_len=256,
-                                     max_num_batched_tokens=64))
-        await engine2.start()
+    pressured = {}
+    for name, over in LOOP_MODES:
+        cfg = _cfg(True, num_kv_blocks=10, max_model_len=256,
+                   max_num_seqs=3, max_num_batched_tokens=64, **over)
+        engine = ServingEngine(cfg)
+        await engine.start()
         try:
-            async def run2(i):
-                toks = []
-                async for o in engine2.generate(
-                    prompt=f"user {i} prompt text",
-                    sampling=SamplingParams(temperature=0.0, max_tokens=40,
-                                            ignore_eos=True),
-                ):
-                    toks = o.token_ids
-                return toks
-            calm = await asyncio.gather(*[run2(i) for i in range(3)])
+            pressured[name] = await run_all(engine)
+            if name == "overlap":
+                assert engine.scheduler.num_preemptions_total > 0, \
+                    "workload no longer exercises preemption"
         finally:
-            await engine2.stop()
-        assert many == calm
+            await engine.stop()
+        assert all(len(t) == 40 for t in pressured[name])
+
+    # determinism across a run with vs without pressure
+    engine2 = ServingEngine(_cfg(True, max_num_seqs=3, max_model_len=256,
+                                 max_num_batched_tokens=64))
+    await engine2.start()
+    try:
+        calm = await run_all(engine2)
     finally:
-        await engine.stop()
+        await engine2.stop()
+    for name, _ in LOOP_MODES:
+        assert pressured[name] == calm, name
+
+
+@pytest.mark.asyncio
+async def test_prefill_arrives_mid_decode_parity():
+    """A fresh prompt submitted while a fused decode scan is in flight:
+    the overlap loop issues its prefill into the second slot instead of
+    queuing it behind the scan — and the outputs (both streams') must be
+    identical across strict/pipeline/overlap loops."""
+    outs = {}
+    for name, over in LOOP_MODES:
+        engine = ServingEngine(_cfg(True, **over))
+        await engine.start()
+        try:
+            results = {}
+
+            async def collect(key, prompt, sp):
+                toks = []
+                async for o in engine.generate(prompt=prompt, sampling=sp):
+                    toks = o.token_ids
+                results[key] = toks
+
+            long_task = asyncio.create_task(collect(
+                "long", "steady decode stream goes on",
+                SamplingParams(temperature=0.0, max_tokens=48,
+                               ignore_eos=True),
+            ))
+            # Wait until the first stream is decoding (dispatches in
+            # flight), then land a fresh prompt mid-decode.
+            for _ in range(400):
+                if engine.scheduler.num_running > 0:
+                    break
+                await asyncio.sleep(0.005)
+            late_task = asyncio.create_task(collect(
+                "late", "a late arriving prompt with some extra words",
+                SamplingParams(temperature=0.9, seed=7, max_tokens=12,
+                               ignore_eos=True),
+            ))
+            await asyncio.gather(long_task, late_task)
+            outs[name] = results
+        finally:
+            await engine.stop()
+    assert outs["overlap"] == outs["strict"]
+    assert outs["pipeline"] == outs["strict"]
+    assert len(outs["overlap"]["long"]) == 48
+    assert len(outs["overlap"]["late"]) == 12

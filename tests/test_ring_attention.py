@@ -64,8 +64,9 @@ def test_ring_is_actually_sharded():
         NamedSharding(mesh, P(None, "sp")))
 
     out = ring_attention(q, k, v, positions, mesh)
-    # Output stays sequence-sharded: each chip holds S/sp tokens.
-    assert out.sharding.spec == P(None, "sp", None, None)
+    # Output stays sequence-sharded: each chip holds S/sp tokens. (Older
+    # jax trims trailing Nones from the spec — compare the leading axes.)
+    assert tuple(out.sharding.spec)[:2] == (None, "sp")
     local = out.addressable_shards[0].data.shape[1]
     assert local == s // sp
     ref = dense_causal(q, k, v, positions)
