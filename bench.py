@@ -91,6 +91,32 @@ def _scrape_prefix_counters(engine_urls) -> tuple:
     return hits, queries
 
 
+def _scrape_handoff_metrics(url: str) -> dict:
+    """Per-engine disagg telemetry from /metrics (role + pstpu:kv_handoff_*)."""
+    import re
+    import urllib.request
+
+    with urllib.request.urlopen(f"{url}/metrics", timeout=10) as resp:
+        text = resp.read().decode("utf-8", "replace")
+    out = {"role": "unified", "kv_handoff_bytes": 0.0,
+           "kv_handoff_seconds": 0.0, "kv_handoffs": 0.0,
+           "kv_handoff_failures": 0.0}
+    for line in text.splitlines():
+        if line.startswith("pstpu:disagg_role"):
+            m = re.search(r'role="([^"]+)"', line)
+            if m:
+                out["role"] = m.group(1)
+        elif line.startswith("pstpu:kv_handoff_bytes_total"):
+            out["kv_handoff_bytes"] = float(line.rsplit(" ", 1)[1])
+        elif line.startswith("pstpu:kv_handoff_seconds_total"):
+            out["kv_handoff_seconds"] = float(line.rsplit(" ", 1)[1])
+        elif line.startswith("pstpu:kv_handoff_failures_total"):
+            out["kv_handoff_failures"] = float(line.rsplit(" ", 1)[1])
+        elif line.startswith("pstpu:kv_handoffs_total"):
+            out["kv_handoffs"] = float(line.rsplit(" ", 1)[1])
+    return out
+
+
 # --------------------------------------------------------------- stack mode
 def bench_stack(args) -> dict:
     from benchmarks.multi_round_qa import (
@@ -154,6 +180,111 @@ def bench_stack(args) -> dict:
         "summary": summary,
         "avg_prompt_tokens": avg_prompt,
         "kv_hit_rate": round((h1 - h0) / max(1.0, q1 - q0), 4),
+    }
+
+
+# -------------------------------------------------------------- disagg mode
+def bench_disagg(args) -> dict:
+    """1-prefill + 1-decode stack over a shared kv_offload store, driven
+    through the router's disagg two-hop flow (docs/DISAGG.md). Reports the
+    usual stack JSON line plus per-role TTFT/ITL attribution and the KV
+    handoff plane's transfer telemetry. Any 5xx fails the run (the
+    workload client raises on error statuses)."""
+    from benchmarks.multi_round_qa import (
+        WorkloadConfig,
+        run_workload,
+        summarize,
+    )
+    from benchmarks.stack import launch_kv_server, launch_stack
+
+    kv_proc, kv_url, kv_log, kv_log_f = launch_kv_server()
+    stack = None
+    try:
+        stack = launch_stack(
+            args.model,
+            engine_args=[
+                "--max-model-len", str(args.max_model_len),
+                "--max-num-seqs", str(max(8, args.users)),
+                "--attn-impl", args.attn_impl,
+                *(["--no-warmup"] if getattr(args, "backend", "") == "cpu"
+                  else []),
+            ],
+            per_engine_args=[["--role", "prefill"], ["--role", "decode"]],
+            engine_env={"LMCACHE_REMOTE_URL": kv_url},
+            routing_logic="disagg",
+            router_args=[
+                "--session-key", "x-user-id",
+                "--kv-offload-url", kv_url,
+                "--static-backend-roles", "prefill,decode",
+            ],
+            num_engines=2,
+        )
+        cfg = WorkloadConfig(
+            base_url=stack.router_url,
+            model=args.model,
+            num_users=args.users,
+            num_rounds=args.rounds,
+            system_prompt_words=args.prompt_len,
+            answer_tokens=args.max_tokens,
+            history_words=_history_words(args),
+        )
+        warm = WorkloadConfig(**{**cfg.__dict__, "num_rounds": 1,
+                                 "tag": "warmup"})
+        asyncio.run(run_workload(warm))
+        h0, q0 = _scrape_prefix_counters(stack.engine_urls)
+        records = asyncio.run(run_workload(cfg))
+        h1, q1 = _scrape_prefix_counters(stack.engine_urls)
+        per_engine = {
+            url: _scrape_handoff_metrics(url) for url in stack.engine_urls
+        }
+    finally:
+        if stack is not None:
+            stack.terminate()
+        if kv_proc.poll() is None:
+            kv_proc.terminate()
+            try:
+                kv_proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001 — last resort
+                kv_proc.kill()
+        kv_log_f.close()
+    summary = summarize(records)
+    if not summary.get("finished_requests"):
+        raise RuntimeError(
+            "disagg benchmark finished zero requests — check the subprocess "
+            f"logs: {stack.log_paths + [kv_log]}"
+        )
+    # Per-role latency attribution: the client-side TTFT covers the prefill
+    # hop + KV handoff; the inter-token cadence after token 1 is pure
+    # decode-pool time.
+    itls = sorted(
+        (r.finish_time - r.launch_time - r.ttft)
+        / max(1, r.generation_tokens - 1)
+        for r in records if r.generation_tokens > 1
+    )
+    roles = {m["role"]: {**m, "url": url} for url, m in per_engine.items()}
+    # Transfer volume counts each bundle ONCE (the publish side); the
+    # per-role dicts keep both sides' counters (publish vs consume time).
+    # Failures are genuinely per-side, so those do sum.
+    pre_side = roles.get("prefill") or {}
+    disagg = {
+        "prefill": roles.get("prefill"),
+        "decode": roles.get("decode"),
+        "kv_handoff_bytes": pre_side.get("kv_handoff_bytes", 0.0),
+        "kv_handoff_seconds": pre_side.get("kv_handoff_seconds", 0.0),
+        "kv_handoff_failures": sum(
+            m["kv_handoff_failures"] for m in per_engine.values()
+        ),
+        "prefill_p50_ttft_s": round(summary["p50_ttft_s"], 4),
+        "decode_p50_itl_s": round(itls[len(itls) // 2], 4) if itls else None,
+    }
+    avg_prompt = summary["total_prompt_tokens"] / summary["finished_requests"]
+    return {
+        "metric": f"disagg_output_throughput_{args.model}_1p1d",
+        "value": round(summary["output_tokens_per_s"], 2),
+        "summary": summary,
+        "avg_prompt_tokens": avg_prompt,
+        "kv_hit_rate": round((h1 - h0) / max(1.0, q1 - q0), 4),
+        "disagg": disagg,
     }
 
 
@@ -323,6 +454,12 @@ def main():
     ap.add_argument("--no-overlap", action="store_true",
                     help="A/B fallback: disable the two-slot prefill/"
                          "decode dispatch overlap")
+    ap.add_argument("--disagg", action="store_true",
+                    help="prefill/decode disaggregation smoke: 1-prefill + "
+                         "1-decode stack over a shared kv_offload store, "
+                         "routed with --routing-logic disagg; reports "
+                         "per-role TTFT/ITL and kv_handoff_* telemetry "
+                         "(docs/DISAGG.md)")
     args = ap.parse_args()
 
     # Probe the backend in a SUBPROCESS: in stack mode the parent must not
@@ -335,8 +472,15 @@ def main():
     ).stdout.strip() or "cpu"
     on_tpu = backend not in ("", "cpu")
     args.model = args.model or ("llama-1b" if on_tpu else "tiny-llama")
+    args.backend = backend
 
-    res = bench_stack(args) if args.mode == "stack" else bench_engine(args)
+    if args.disagg:
+        args.mode = "stack"  # disagg is a stack-shape run (JSON line parity)
+        res = bench_disagg(args)
+    elif args.mode == "stack":
+        res = bench_stack(args)
+    else:
+        res = bench_engine(args)
     summary = res["summary"]
 
     from production_stack_tpu.engine.config import EngineConfig
@@ -370,6 +514,8 @@ def main():
             "input_tok_s": round(summary["input_tokens_per_s"], 1),
             "avg_ttft_s": round(summary["avg_ttft_s"], 4),
         })
+    if "disagg" in res:
+        out["disagg"] = res["disagg"]
     print(json.dumps(out))
     return 0
 

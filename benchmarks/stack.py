@@ -22,6 +22,47 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
+def wait_tcp(host: str, port: int, timeout_s: float, proc: subprocess.Popen,
+             name: str) -> None:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"{name} exited with code {proc.returncode} before listening"
+            )
+        try:
+            socket.create_connection((host, port), 0.5).close()
+            return
+        except OSError:
+            time.sleep(0.2)
+    raise TimeoutError(f"{name} not listening on {host}:{port} "
+                       f"after {timeout_s}s")
+
+
+def launch_kv_server(max_bytes: int = 1 << 30, log_dir: str = "/tmp"):
+    """Start the Python cache server as a subprocess; returns
+    (Popen, kv_url, log_path, log_file). The disagg bench mode's handoff
+    plane and the engines' LMCACHE_REMOTE_URL both point at it."""
+    port = free_port()
+    log = os.path.join(log_dir, f"pstpu-bench-kvserver-{port}.log")
+    log_f = open(log, "w")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "production_stack_tpu.kv_offload.server",
+            "--force-python", "--host", "127.0.0.1", "--port", str(port),
+            "--max-bytes", str(max_bytes),
+        ],
+        stdout=log_f, stderr=subprocess.STDOUT,
+    )
+    try:
+        wait_tcp("127.0.0.1", port, 60.0, proc, "kv_server")
+    except Exception:
+        proc.kill()
+        log_f.close()
+        raise
+    return proc, f"kv://127.0.0.1:{port}", log, log_f
+
+
 def wait_health(url: str, timeout_s: float, proc: subprocess.Popen,
                 name: str) -> None:
     deadline = time.monotonic() + timeout_s
@@ -84,11 +125,16 @@ def launch_stack(
     startup_timeout_s: float = 1800.0,
     log_dir: str = "/tmp",
     num_engines: int = 1,
+    per_engine_args: Optional[List[List[str]]] = None,
+    engine_env: Optional[dict] = None,
 ) -> StackHandle:
     """Start ``num_engines`` engine pods + the router; block until all are
     healthy. Multiple engines make the load-balancing routing logics
     (e.g. cache_aware_load_balancing) actually route — the 2-process
-    opt-125m smoke path in the benchmark sweep."""
+    opt-125m smoke path in the benchmark sweep. ``per_engine_args[i]`` are
+    appended to engine i's argv (role-split disagg pools) and
+    ``engine_env`` entries override the inherited environment (e.g.
+    LMCACHE_REMOTE_URL for the shared offload store)."""
     router_port = free_port()
     router_url = f"http://127.0.0.1:{router_port}"
     served = served_model or model
@@ -99,7 +145,7 @@ def launch_stack(
     log_files: List[object] = []
     rlog_f = None
     try:
-        for _ in range(max(1, num_engines)):
+        for i in range(max(1, num_engines)):
             engine_port = free_port()
             engine_url = f"http://127.0.0.1:{engine_port}"
             elog = os.path.join(
@@ -108,14 +154,20 @@ def launch_stack(
             elog_f = open(elog, "w")
             log_paths.append(elog)
             log_files.append(elog_f)
+            extra = (
+                per_engine_args[i]
+                if per_engine_args and i < len(per_engine_args) else []
+            )
             engines.append(subprocess.Popen(
                 [
                     sys.executable, "-m",
                     "production_stack_tpu.server.api_server",
                     "--model", model, "--port", str(engine_port),
                     *(engine_args or []),
+                    *extra,
                 ],
                 stdout=elog_f, stderr=subprocess.STDOUT,
+                env=({**os.environ, **engine_env} if engine_env else None),
             ))
             engine_urls.append(engine_url)
         for engine, engine_url in zip(engines, engine_urls):

@@ -1,0 +1,38 @@
+"""Prefill/decode disaggregation (DistServe/Splitwise-shaped role split).
+
+Engines run with ``--role {unified,prefill,decode}``: a prefill engine
+computes a prompt's KV + first token, serializes them through the
+``kv_offload`` serde, and publishes the bundle to the shared remote KV
+store under a transfer key; a decode engine consumes the bundle (the
+store lease is delete-after-consume), rehydrates the blocks into its own
+HBM pool, and continues the stream from token 1 with no recompute. The
+router's ``DisaggRouter`` orchestrates the two hops
+(production_stack_tpu/router/routing_logic.py + request_service.py);
+docs/DISAGG.md has the architecture and failure semantics.
+"""
+
+from production_stack_tpu.disagg.coordinator import DisaggCoordinator
+from production_stack_tpu.disagg.transfer import (
+    DISAGG_ENDPOINT_HEADER,
+    DISAGG_FALLBACK_HEADER,
+    DISAGG_KEY_HEADER,
+    DISAGG_ROLE_HEADER,
+    ENGINE_ROLES,
+    HandoffManifest,
+    TransferManager,
+    pack_manifest,
+    unpack_manifest,
+)
+
+__all__ = [
+    "DISAGG_ENDPOINT_HEADER",
+    "DISAGG_FALLBACK_HEADER",
+    "DISAGG_KEY_HEADER",
+    "DISAGG_ROLE_HEADER",
+    "ENGINE_ROLES",
+    "DisaggCoordinator",
+    "HandoffManifest",
+    "TransferManager",
+    "pack_manifest",
+    "unpack_manifest",
+]

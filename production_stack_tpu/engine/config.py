@@ -93,6 +93,16 @@ class EngineConfig:
     # prefill's tokens are applied (single-source token chaining). False is
     # the fallback to the round-5 one-batch-per-round loop.
     overlap_dispatch: bool = True
+    # --- prefill/decode disaggregation (docs/DISAGG.md) ---
+    # "unified" serves prompts end-to-end. "prefill" computes prompt KV +
+    # the first token, publishes them to the remote KV store under the
+    # request's transfer key, and finishes ("handoff"); its scheduler never
+    # forms decode batches except for router-flagged fallback traffic.
+    # "decode" rehydrates published KV into its own pool and continues the
+    # stream from token 1 with no recompute; its scheduler never forms
+    # prefill batches except for fallback traffic. Non-unified roles
+    # require kv_remote_url (the handoff rides the offload store).
+    role: str = "unified"
     # --- KV offload (LMCache-equivalent; env names mirror the reference chart)
     kv_offload_cpu: bool = field(
         default_factory=lambda: os.environ.get("LMCACHE_LOCAL_CPU", "").lower() == "true"

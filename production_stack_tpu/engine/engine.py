@@ -116,6 +116,23 @@ class ServingEngine:
                 remote_url=config.kv_remote_url,
                 serde=config.kv_remote_serde,
             )
+        # Prefill/decode disaggregation (docs/DISAGG.md): non-unified roles
+        # get a coordinator for the KV handoff plane (its own store
+        # connection, separate from the offload spiller's).
+        from production_stack_tpu.disagg.transfer import ENGINE_ROLES
+
+        if config.role not in ENGINE_ROLES:
+            raise ValueError(
+                f"Unknown engine role {config.role!r} "
+                f"(supported: {', '.join(ENGINE_ROLES)})"
+            )
+        self.disagg = None
+        if config.role != "unified":
+            from production_stack_tpu.disagg import DisaggCoordinator
+
+            self.disagg = DisaggCoordinator(
+                config, self.runner, self.block_manager
+            )
         self.scheduler = Scheduler(
             config, self.block_manager, offload=self.offload,
             decode_window_budget=self.runner.decode_window_blocks,
@@ -124,6 +141,13 @@ class ServingEngine:
 
         self._streams: Dict[str, _StreamState] = {}
         self._pending_aborts: Set[str] = set()
+        # Decode-hop restores waiting for the engine loop: (Sequence,
+        # HandoffManifest) pairs. Applied between device steps so the
+        # host->device KV write is ordered with model dispatches.
+        self._pending_restores: List = []
+        # In-flight handoff publishes (background tasks): awaited at loop
+        # exit so no accepted handoff is lost on shutdown.
+        self._publish_tasks: Set = set()
         self._step_counter = 0
         self._new_work = asyncio.Event()
         self._loop_task: Optional[asyncio.Task] = None
@@ -188,6 +212,8 @@ class ServingEngine:
             self._loop_task = None
         if self.offload is not None:
             self.offload.close()
+        if self.disagg is not None:
+            self.disagg.close()
         if self._dispatch_log is not None:
             self._dispatch_log.close()
             self._dispatch_log = None
@@ -210,11 +236,38 @@ class ServingEngine:
         sampling: Optional[SamplingParams] = None,
         request_id: Optional[str] = None,
         lora_adapter: Optional[str] = None,
+        handoff_key: Optional[str] = None,
+        handoff_state=None,
+        disagg_fallback: bool = False,
     ) -> AsyncIterator[RequestOutput]:
         """Submit a request; yields streaming RequestOutput deltas.
-        ``lora_adapter`` selects a registered adapter by name (None = base)."""
+        ``lora_adapter`` selects a registered adapter by name (None = base).
+
+        Disagg hops (docs/DISAGG.md): ``handoff_key`` makes this the
+        PREFILL hop — the prompt is prefilled, token 1 sampled, KV + chain
+        state published under the key, and the stream finishes with reason
+        "handoff". ``handoff_state`` (a HandoffManifest) makes this the
+        DECODE hop — the published KV is rehydrated into the local pool and
+        the stream continues from token 1 with no recompute.
+        ``disagg_fallback`` marks router-flagged degrade-to-unified traffic
+        so a role-split scheduler admits both phases for it."""
         request_id = request_id or random_uuid("req-")
         sampling = sampling or SamplingParams()
+        if (handoff_key or handoff_state is not None) and self.disagg is None:
+            raise ValueError(
+                "disagg handoff requested but this engine has no coordinator "
+                "(--role unified)"
+            )
+        if (handoff_key or handoff_state is not None) and lora_adapter:
+            raise ValueError("disagg handoff does not support LoRA adapters")
+
+        if handoff_state is not None:
+            async for out in self._generate_from_handoff(
+                handoff_state, sampling, request_id
+            ):
+                yield out
+            return
+
         if prompt_token_ids is None:
             assert prompt is not None
             prompt_token_ids = self.tokenizer.encode(prompt)
@@ -232,6 +285,8 @@ class ServingEngine:
             eos_token_id=self.tokenizer.eos_token_id,
             adapter_idx=adapter_idx,
             adapter_name=lora_adapter if adapter_idx else None,
+            handoff_key=handoff_key,
+            disagg_fallback=disagg_fallback,
         )
         state = _StreamState(
             queue=asyncio.Queue(), detok=IncrementalDetokenizer(self.tokenizer)
@@ -239,6 +294,86 @@ class ServingEngine:
         self._streams[request_id] = state
         self.scheduler.add_sequence(seq)
         self.prompt_tokens_total += len(prompt_token_ids)
+        self._new_work.set()
+        try:
+            while True:
+                out: RequestOutput = await state.queue.get()
+                yield out
+                if out.finished:
+                    break
+        finally:
+            self._streams.pop(request_id, None)
+            if not seq.status.is_finished:
+                self.abort(request_id)
+
+    async def _generate_from_handoff(
+        self, mani, sampling: SamplingParams, request_id: str
+    ) -> AsyncIterator[RequestOutput]:
+        """Decode hop: continue a stream from a consumed transfer bundle.
+
+        Finished bundles (the prefill engine hit EOS/max_tokens/stop at
+        token 1) replay the recorded result verbatim — stop-trim corner
+        cases are not re-derived. Live bundles enqueue a restore the engine
+        loop applies between device steps (KV write ordering)."""
+        if mani.finish_reason is not None:
+            # Token counters are NOT bumped here: the prefill engine already
+            # counted this request's prompt + replayed tokens; counting them
+            # again would double-book fleet-wide token totals.
+            yield RequestOutput(
+                request_id=request_id,
+                text_delta=mani.final_text or "",
+                token_ids=list(mani.output_token_ids),
+                finished=True,
+                finish_reason=mani.finish_reason,
+                num_prompt_tokens=len(mani.prompt_token_ids),
+                num_output_tokens=len(mani.output_token_ids),
+                num_cached_tokens=mani.num_computed_tokens,
+                logprobs=(
+                    list(mani.output_logprobs)
+                    if sampling.logprobs is not None
+                    and mani.output_logprobs is not None else None
+                ),
+            )
+            return
+        if mani.block_size != self.config.block_size:
+            raise ValueError(
+                f"handoff block_size {mani.block_size} != engine block_size "
+                f"{self.config.block_size} (pools must share the KV layout)"
+            )
+        bs = self.config.block_size
+        need = mani.num_blocks
+        if (
+            need > self.block_manager.num_blocks - 1
+            or len(mani.prompt_token_ids) >= self.config.max_model_len
+        ):
+            raise ValueError(
+                "handoff bundle exceeds this engine's KV pool / max_model_len"
+            )
+        if need * bs < mani.num_computed_tokens:
+            raise ValueError("handoff bundle is missing KV blocks")
+        seq = Sequence(
+            request_id=request_id,
+            prompt_token_ids=list(mani.prompt_token_ids),
+            sampling=sampling,
+            eos_token_id=self.tokenizer.eos_token_id,
+            # A restored row preempted under KV pressure is requeued as a
+            # recompute-by-prefill candidate; the transfer lease is already
+            # consumed, so local end-to-end serving is its ONLY path — the
+            # fallback flag keeps the decode-role prefill-admission gate
+            # from starving it forever.
+            disagg_fallback=True,
+        )
+        state = _StreamState(
+            queue=asyncio.Queue(), detok=IncrementalDetokenizer(self.tokenizer)
+        )
+        self._streams[request_id] = state
+        # Registered before the restore applies so a client disconnect while
+        # queued aborts cleanly (scheduler.abort finds the sequence).
+        self.scheduler.seqs[request_id] = seq
+        self._pending_restores.append((seq, mani))
+        # prompt_tokens_total deliberately not bumped: the prefill engine
+        # already counted this prompt (fleet-wide sums must not double-book
+        # a disagg request's tokens).
         self._new_work.set()
         try:
             while True:
@@ -353,6 +488,7 @@ class ServingEngine:
             self.generation_tokens_total += accepted
             for seq in produced:
                 self._process_output(seq)
+            await self._publish_handoffs(produced)
 
         async def drain():
             while in_flight:
@@ -372,6 +508,8 @@ class ServingEngine:
 
         while self._running:
             self._apply_pending_aborts()
+            if self._pending_restores:
+                await self._apply_restores()
             issue_failed = False
             while len(in_flight) < depth and not issue_failed:
                 batch = next_batch()
@@ -424,7 +562,7 @@ class ServingEngine:
             # Idle: drop the persistent decode window so its (up to
             # window-budget-sized) device buffers don't pin HBM.
             self.runner._win_cache = None
-            if not self.scheduler.has_work():
+            if not self.scheduler.has_work() and not self._pending_restores:
                 try:
                     await asyncio.wait_for(self._new_work.wait(), timeout=1.0)
                 except asyncio.TimeoutError:
@@ -433,8 +571,13 @@ class ServingEngine:
                 # Work exists but nothing schedulable (pool starved by
                 # in-flight requests) — yield and retry.
                 await asyncio.sleep(0.001)
-        # Drain on shutdown so no accepted tokens are lost.
+        # Drain on shutdown so no accepted tokens are lost, and let
+        # in-flight handoff publishes finish so accepted transfers reach
+        # the store.
         await drain()
+        if self._publish_tasks:
+            await asyncio.gather(*list(self._publish_tasks),
+                                 return_exceptions=True)
 
     def _apply_pending_aborts(self) -> None:
         while self._pending_aborts:
@@ -442,6 +585,143 @@ class ServingEngine:
             seq = self.scheduler.abort(rid)
             if seq is not None:
                 self._process_output(seq)
+
+    # --------------------------------------------------- disagg handoff plane
+    async def _apply_restores(self) -> None:
+        """Rehydrate queued decode-hop transfers into the local KV pool.
+
+        Driven by the engine loop between device steps (same ordering
+        discipline as offload.try_restore): blocks are allocated, the
+        published KV is scattered in (the device write — a multi-MB
+        transfer and possibly a first-use scatter compile — runs on the
+        worker executor so SSE/health never freeze; the loop awaits it, so
+        no dispatch is issued concurrently), the already-sampled tokens are
+        replayed through the normal append path (EOS/max_tokens/stop-token
+        semantics re-applied deterministically), and the row joins RUNNING —
+        the next decode dispatch continues it with zero recompute. A pool
+        too full to allocate right now re-queues the restore; aborted-while-
+        queued rows are dropped; a restore that fails outright (geometry
+        mismatch, corrupt blob, device error) aborts ONLY its own request —
+        the engine loop must survive."""
+        loop = asyncio.get_running_loop()
+        pending, self._pending_restores = self._pending_restores, []
+        leftover = []
+        for seq, mani in pending:
+            if seq.status.is_finished:
+                continue  # aborted while queued
+            try:
+                blocks = (
+                    self.block_manager.allocate_blocks(mani.num_blocks)
+                    if mani.num_blocks else []
+                )
+                if blocks is None:
+                    leftover.append((seq, mani))
+                    continue
+                # Assigned before the write so a failure path (or a later
+                # abort) frees them through the normal _finish bookkeeping.
+                seq.block_ids = blocks
+                if mani.num_blocks:
+                    await loop.run_in_executor(
+                        None, self.runner.write_blocks, blocks, mani.k,
+                        mani.v,
+                    )
+                seq.num_computed_tokens = mani.num_computed_tokens
+                seq.num_cached_tokens = mani.num_computed_tokens
+                seq.status = SequenceStatus.RUNNING
+                self.scheduler.running.append(seq)
+                for i, tok in enumerate(mani.output_token_ids):
+                    lp = None
+                    if mani.output_logprobs and i < len(mani.output_logprobs):
+                        lp = mani.output_logprobs[i]
+                    if seq.status.is_finished:
+                        break  # defensive: same finish logic ran upstream
+                    self.scheduler._append_token(seq, tok, lp)
+                # Content-address the restored full blocks: later sessions
+                # with the same prefix hit this engine's device cache
+                # directly. (Replayed tokens are not added to
+                # generation_tokens_total — the prefill engine counted them
+                # at its apply.)
+                self.scheduler._register_full_blocks(seq)
+                self._process_output(seq)
+            except Exception:  # noqa: BLE001 — engine loop must survive
+                logger.exception("Handoff restore failed; aborting %s",
+                                 seq.request_id)
+                aborted = self.scheduler.abort(seq.request_id)
+                if aborted is not None:
+                    self._process_output(aborted)
+        self._pending_restores.extend(leftover)
+
+    async def _publish_handoffs(self, produced: List[Sequence]) -> None:
+        """Prefill hop completion: rows that just produced their first
+        token and carry a transfer key get a BACKGROUND publish task
+        (device read + serialize + store put must not stall the dispatch
+        pipeline — on a prefill-role engine that would serialize every
+        prompt behind the previous one's network put). While the publish
+        is in flight the row sits in RUNNING but is excluded from decode
+        batches (handoff_key gate) and from preemption victims (its blocks
+        are mid-read); on completion the row finishes (FINISHED_HANDOFF
+        frees its blocks into the prefix cache) and the /disagg/prefill
+        response is emitted. Publish failure aborts the row so the
+        router's resilience layer retries or degrades to unified serving —
+        a prefill-role engine never silently starts decoding."""
+        if self.disagg is None:
+            return
+        for seq in produced:
+            if seq.handoff_key is None or seq.handoff_done:
+                continue
+            if not seq.prefill_done:
+                continue
+            seq.handoff_done = True
+            st = self._streams.get(seq.request_id)
+            final_text = (
+                st.text if (st is not None and seq.status.is_finished)
+                else None
+            )
+            task = asyncio.ensure_future(self._publish_one(seq, final_text))
+            self._publish_tasks.add(task)
+            task.add_done_callback(self._publish_tasks.discard)
+
+    async def _publish_one(self, seq: Sequence,
+                           final_text: Optional[str]) -> None:
+        loop = asyncio.get_running_loop()
+        try:
+            ok = await loop.run_in_executor(
+                None, self.disagg.publish_handoff, seq, final_text
+            )
+        except Exception:  # noqa: BLE001 — publish must fail cleanly
+            logger.exception("KV handoff publish task failed")
+            ok = False
+        # finish + emit run in ONE loop slice (no awaits), so the scheduler
+        # never observes a half-finished handoff row.
+        if not seq.status.is_finished:
+            self.scheduler.finish(
+                seq.request_id,
+                SequenceStatus.FINISHED_HANDOFF if ok
+                else SequenceStatus.FINISHED_ABORTED,
+            )
+        self._emit_handoff_output(seq)
+
+    def _emit_handoff_output(self, seq: Sequence) -> None:
+        """The single (final) stream emission of a prefill-hop row — its
+        incremental outputs are held back (see _process_output) so the
+        /disagg/prefill response reflects the post-publish outcome."""
+        st = self._streams.get(seq.request_id)
+        if st is None:
+            return
+        st.queue.put_nowait(RequestOutput(
+            request_id=seq.request_id,
+            text_delta=st.text,
+            token_ids=list(seq.output_token_ids),
+            finished=True,
+            finish_reason=seq.finish_reason(),
+            num_prompt_tokens=seq.num_prompt_tokens,
+            num_output_tokens=len(seq.output_token_ids),
+            num_cached_tokens=seq.num_cached_tokens,
+            logprobs=(
+                list(seq.output_logprobs)
+                if seq.sampling.logprobs is not None else None
+            ),
+        ))
 
     # ------------------------------------------------------------- emissions
     def _process_output(self, seq: Sequence) -> None:
@@ -521,6 +801,15 @@ class ServingEngine:
                         seq.request_id, SequenceStatus.FINISHED_STOPPED
                     )
                 finished = True
+        if seq.handoff_key is not None:
+            # Prefill-hop rows defer emission to _emit_handoff_output: the
+            # detok/stop state above still advances (final_text for finished
+            # bundles), but the /disagg/prefill response must carry the
+            # post-publish outcome, not a premature token delta. Aborts
+            # (client gone, drain) must still unblock the handler's stream.
+            if seq.status is SequenceStatus.FINISHED_ABORTED:
+                self._emit_handoff_output(seq)
+            return
         hold = 0 if finished or not stops else max(len(s) for s in stops) - 1
         emit_upto = max(len(st.text) - hold, st.sent)
         text_delta = st.text[st.sent:emit_upto]
@@ -542,7 +831,15 @@ class ServingEngine:
 
     # ------------------------------------------------------------------ stats
     def stats(self) -> Dict:
+        disagg = self.disagg.stats() if self.disagg is not None else {
+            "kv_handoffs_total": 0,
+            "kv_handoff_bytes_total": 0,
+            "kv_handoff_seconds_total": 0.0,
+            "kv_handoff_failures_total": 0,
+        }
         return {
+            "disagg_role": self.config.role,
+            **disagg,
             "num_requests_running": self.scheduler.num_running,
             "num_requests_waiting": self.scheduler.num_waiting,
             "kv_cache_usage": self.block_manager.usage(),

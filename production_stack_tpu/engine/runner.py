@@ -1134,6 +1134,20 @@ class ModelRunner:
         v_np = np.asarray(v_g).transpose(2, 0, 1, 3, 4)[:n]
         return k_np, v_np
 
+    def read_blocks_retry(self, block_ids: List[int], attempts: int = 3):
+        """read_blocks with retry against donation races: an engine step may
+        donate the pool buffers mid-read (RuntimeError on TPU, ValueError
+        INVALID_ARGUMENT on the CPU backend); the retry re-reads the
+        rebound arrays. The ONE helper shared by the offload spiller and
+        the disagg handoff publisher."""
+        for attempt in range(attempts):
+            try:
+                return self.read_blocks(block_ids)
+            except (RuntimeError, ValueError):
+                if attempt == attempts - 1:
+                    raise
+                time.sleep(0.01)
+
     def write_blocks(self, block_ids: List[int], k_np, v_np) -> None:
         """Host->device restore of whole KV blocks.
 

@@ -62,6 +62,9 @@ class SequenceStatus(enum.Enum):
     FINISHED_STOPPED = "stop"
     FINISHED_LENGTH = "length"
     FINISHED_ABORTED = "abort"
+    # Disagg prefill hop complete: KV + chain state published to the remote
+    # store; a decode engine continues the stream (docs/DISAGG.md).
+    FINISHED_HANDOFF = "handoff"
 
     @property
     def is_finished(self) -> bool:
@@ -69,6 +72,7 @@ class SequenceStatus(enum.Enum):
             SequenceStatus.FINISHED_STOPPED,
             SequenceStatus.FINISHED_LENGTH,
             SequenceStatus.FINISHED_ABORTED,
+            SequenceStatus.FINISHED_HANDOFF,
         )
 
 
@@ -105,6 +109,18 @@ class Sequence:
     # prefix-cache namespace (models/lora.py).
     adapter_idx: int = 0
     adapter_name: Optional[str] = None
+    # --- prefill/decode disaggregation (docs/DISAGG.md) ---
+    # Transfer key for the disagg prefill hop: once the prompt is prefilled
+    # and token 1 sampled, the engine publishes KV + chain state under this
+    # key and finishes the sequence (FINISHED_HANDOFF). Such a row must
+    # NEVER join a decode batch — if publication fails the row is aborted,
+    # not silently decoded on a prefill-role engine.
+    handoff_key: Optional[str] = None
+    handoff_done: bool = False
+    # Router-flagged fallback traffic: the request is served end-to-end
+    # (unified) on this engine even when its role would normally refuse the
+    # other phase — the degrade path when a disagg pool is down.
+    disagg_fallback: bool = False
 
     @property
     def hash_seed(self) -> bytes:
@@ -277,6 +293,12 @@ class Scheduler:
         for cand in list(self.waiting):
             if len(cands) >= max_rows:
                 break
+            if self.config.role == "decode" and not cand.disagg_fallback:
+                # Role admission: a decode-role engine never schedules
+                # prefill batches for disagg-conforming traffic; it prefills
+                # only router-flagged fallback requests (decode-hop rows are
+                # restored straight to RUNNING, never queued here).
+                continue
             if not cand.block_ids:
                 alloc = self.block_manager.allocate_prompt(
                     cand.all_token_ids, seed=cand.hash_seed
@@ -393,6 +415,15 @@ class Scheduler:
                 # (overlap_dispatch single-source invariant). It joins the
                 # dispatch after that prefill's apply.
                 continue
+            if seq.handoff_key is not None:
+                # Disagg prefill hop: the row finishes at token 1 via the
+                # handoff publish (engine loop); it never decodes here —
+                # the decode-pool engine continues the stream.
+                continue
+            if self.config.role == "prefill" and not seq.disagg_fallback:
+                # Role admission: a prefill-role engine never schedules
+                # decode batches except for router-flagged fallback traffic.
+                continue
             # Positions written this dispatch: pos .. pos+want-1. `want` is
             # capped by model-length capacity and the request's remaining
             # token budget (counting in-flight unapplied tokens) so the
@@ -502,8 +533,16 @@ class Scheduler:
 
     def _pick_preemption_victim(self, exclude: Seq[Sequence]) -> Optional[Sequence]:
         for seq in reversed(self.running):
-            if seq not in exclude:
-                return seq
+            if seq in exclude:
+                continue
+            if seq.handoff_key is not None:
+                # A handoff row's KV may be mid-read by the (asynchronous)
+                # publish; preempting would free — and let the pool
+                # recycle — the very blocks being serialized. The row
+                # finishes right after the publish anyway, so skipping it
+                # cannot starve the pool for long.
+                continue
+            return seq
         return None
 
     def _preempt(self, seq: Sequence) -> None:

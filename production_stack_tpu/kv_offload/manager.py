@@ -117,16 +117,9 @@ class KVOffloadManager:
         if not live:
             return
         blks = [blk for _, blk in live]
-        for attempt in range(3):
-            try:
-                k_np, v_np = self.runner.read_blocks(blks)
-                break
-            except RuntimeError:
-                # The engine step donated the pool buffers mid-read; retry
-                # against the rebound arrays.
-                if attempt == 2:
-                    raise
-                time.sleep(0.01)
+        # Donation-race retry lives in the runner (shared with the disagg
+        # handoff publisher).
+        k_np, v_np = self.runner.read_blocks_retry(blks)
         for i, (h, blk) in enumerate(live):
             if self.block_manager.hash_of_block(blk) != h:
                 continue  # recycled during the read; data is unreliable

@@ -7,9 +7,11 @@ with native/kv_server.cpp and the Python fallback server):
   request:  op(1) | key_len(u32 LE) | key | val_len(u64 LE) | val
   response: status(1: 0=ok, 1=missing, 2=error) | val_len(u64 LE) | val
 
-ops: 'P' put, 'G' get, 'E' exists, 'T' stats(JSON). One request in flight
-per connection; the client serializes with a lock (callers run on the
-engine's spiller thread, never the event loop).
+ops: 'P' put, 'G' get, 'E' exists, 'D' delete, 'T' stats(JSON). One request
+in flight per connection; the client serializes with a lock (callers run on
+the engine's spiller thread or the disagg handoff executor, never the event
+loop). The native C++ server predates 'D' and answers it with STATUS_ERROR;
+delete() treats that as "not deleted" rather than raising.
 """
 
 import json
@@ -28,6 +30,15 @@ STATUS_MISSING = 1
 STATUS_ERROR = 2
 
 
+def parse_kv_url(url: str):
+    """(host, port) from a store URL: ``kv://host:port`` (also ``tcp://``,
+    ``lm://``, or a bare host:port — the LMCACHE_REMOTE_URL shapes). The
+    single parser shared by this client and the router's parse-time
+    reachability probe, so both always resolve the same endpoint."""
+    parsed = urlparse(url if "//" in url else f"kv://{url}")
+    return parsed.hostname or "localhost", parsed.port or 8200
+
+
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray()
     while len(buf) < n:
@@ -43,9 +54,7 @@ class RemoteKVClient:
                  io_timeout: float = 30.0):
         """url: ``kv://host:port`` (also accepts ``tcp://`` / bare host:port,
         mirroring the reference's LMCACHE_REMOTE_URL shape)."""
-        parsed = urlparse(url if "//" in url else f"kv://{url}")
-        self.host = parsed.hostname or "localhost"
-        self.port = parsed.port or 8200
+        self.host, self.port = parse_kv_url(url)
         self.connect_timeout = connect_timeout
         self.io_timeout = io_timeout
         self._sock: Optional[socket.socket] = None
@@ -63,25 +72,35 @@ class RemoteKVClient:
 
     def _request(self, op: bytes, key: bytes, val: bytes = b""):
         with self._lock:
-            try:
-                sock = self._ensure_sock()
-                sock.sendall(
-                    op + struct.pack("<I", len(key)) + key
-                    + struct.pack("<Q", len(val)) + val
-                )
-                status = _recv_exact(sock, 1)[0]
-                (vlen,) = struct.unpack("<Q", _recv_exact(sock, 8))
-                payload = _recv_exact(sock, vlen) if vlen else b""
-                return status, payload
-            except (OSError, ConnectionError) as e:
-                # Drop the connection; next call reconnects.
-                if self._sock is not None:
-                    try:
-                        self._sock.close()
-                    except OSError:
-                        pass
-                    self._sock = None
-                raise ConnectionError(f"KV server request failed: {e}") from e
+            # One-shot reconnect retry: a server restart leaves this client
+            # holding a dead socket, and the FIRST request after it fails
+            # with EPIPE/ECONNRESET on send (or EOF on recv) even though the
+            # server is back. Requests are whole-message and idempotent at
+            # this layer, so retrying once on a fresh connection is safe; a
+            # second failure means the server is really down.
+            for attempt in (0, 1):
+                try:
+                    sock = self._ensure_sock()
+                    sock.sendall(
+                        op + struct.pack("<I", len(key)) + key
+                        + struct.pack("<Q", len(val)) + val
+                    )
+                    status = _recv_exact(sock, 1)[0]
+                    (vlen,) = struct.unpack("<Q", _recv_exact(sock, 8))
+                    payload = _recv_exact(sock, vlen) if vlen else b""
+                    return status, payload
+                except (OSError, ConnectionError) as e:
+                    # Drop the connection; the retry (or next call) reconnects.
+                    if self._sock is not None:
+                        try:
+                            self._sock.close()
+                        except OSError:
+                            pass
+                        self._sock = None
+                    if attempt == 1:
+                        raise ConnectionError(
+                            f"KV server request failed: {e}"
+                        ) from e
 
     # ------------------------------------------------------------------- API
     def put(self, key: bytes, blob: bytes) -> bool:
@@ -94,6 +113,13 @@ class RemoteKVClient:
 
     def exists(self, key: bytes) -> bool:
         status, _ = self._request(b"E", key)
+        return status == STATUS_OK
+
+    def delete(self, key: bytes) -> bool:
+        """Remove a key (disagg delete-after-consume lease; frees the
+        server's host memory for consumed transfer bundles). True iff the
+        key existed and was deleted."""
+        status, _ = self._request(b"D", key)
         return status == STATUS_OK
 
     def stats(self) -> dict:

@@ -45,6 +45,7 @@ class PyKVServer:
         self._data: "OrderedDict[bytes, bytes]" = OrderedDict()
         self._bytes = 0
         self.hits = self.misses = self.stores = self.evictions = 0
+        self.deletes = 0
 
     async def handle(self, reader: asyncio.StreamReader,
                      writer: asyncio.StreamWriter) -> None:
@@ -88,12 +89,23 @@ class PyKVServer:
             return STATUS_OK, blob
         if op == b"E":
             return (STATUS_OK if key in self._data else STATUS_MISSING), b""
+        if op == b"D":
+            # Delete-after-consume lease for disagg transfer bundles: the
+            # decode engine frees the blob once rehydrated so consumed
+            # transfers don't sit in host memory until LRU pressure.
+            old = self._data.pop(key, None)
+            if old is None:
+                return STATUS_MISSING, b""
+            self._bytes -= len(old)
+            self.deletes += 1
+            return STATUS_OK, b""
         if op == b"T":
             return STATUS_OK, json.dumps({
                 "entries": len(self._data), "bytes": self._bytes,
                 "max_bytes": self.max_bytes, "hits": self.hits,
                 "misses": self.misses, "stores": self.stores,
-                "evictions": self.evictions, "impl": "python",
+                "evictions": self.evictions, "deletes": self.deletes,
+                "impl": "python",
             }).encode()
         return STATUS_ERROR, b""
 

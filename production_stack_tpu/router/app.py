@@ -38,6 +38,7 @@ from production_stack_tpu.router.files_service import initialize_storage
 from production_stack_tpu.router.request_service import (
     _error,
     resilient_json_request,
+    route_disagg_request,
     route_general_request,
 )
 from production_stack_tpu.router.resilience import (
@@ -47,6 +48,8 @@ from production_stack_tpu.router.resilience import (
 )
 from production_stack_tpu.router.rewriter import get_request_rewriter
 from production_stack_tpu.router.routing_logic import (
+    DisaggRouter,
+    get_routing_logic,
     initialize_routing_logic,
 )
 from production_stack_tpu.router.service_discovery import (
@@ -84,6 +87,8 @@ async def handle_chat_completions(request: web.Request) -> web.StreamResponse:
         hit = await cache.check(request)
         if hit is not None:
             return hit
+    if isinstance(get_routing_logic(), DisaggRouter):
+        return await route_disagg_request(request, "/v1/chat/completions")
     return await route_general_request(request, "/v1/chat/completions")
 
 
@@ -93,6 +98,8 @@ async def handle_completions(request: web.Request) -> web.StreamResponse:
         blocked = await pii.check(request)
         if blocked is not None:
             return blocked
+    if isinstance(get_routing_logic(), DisaggRouter):
+        return await route_disagg_request(request, "/v1/completions")
     return await route_general_request(request, "/v1/completions")
 
 
@@ -260,7 +267,14 @@ def initialize_all(app: web.Application, args) -> None:
         models = [[m] for m in parse_static_model_names(args.static_models)]
         if len(models) == 1 and len(urls) > 1:
             models = models * len(urls)
-        initialize_service_discovery("static", urls=urls, models=models)
+        roles = None
+        if getattr(args, "static_backend_roles", None):
+            roles = [
+                r.strip() for r in args.static_backend_roles.split(",")
+            ]
+        initialize_service_discovery(
+            "static", urls=urls, models=models, roles=roles
+        )
     else:
         initialize_service_discovery(
             "k8s", namespace=args.k8s_namespace, port=args.k8s_port,

@@ -94,3 +94,13 @@ router_deadline_exceeded_total = Counter(
     "Requests aborted on a deadline (kind: ttft or total)",
     ["server", "kind"],
 )
+# Prefill/decode disaggregation (docs/DISAGG.md): two-hop flow outcomes.
+router_disagg_handoffs_total = Counter(
+    "router_disagg_handoffs",
+    "Prefill->decode handoffs completed through the two-hop flow", [],
+)
+router_disagg_fallbacks_total = Counter(
+    "router_disagg_fallbacks",
+    "Disagg-routed requests degraded to unified serving",
+    ["reason"],
+)

@@ -25,11 +25,19 @@ def parse_args(argv=None) -> argparse.Namespace:
 
     p.add_argument("--routing-logic", default="roundrobin",
                    choices=["roundrobin", "session",
-                            "cache_aware_load_balancing"])
+                            "cache_aware_load_balancing", "disagg"])
     p.add_argument("--session-key", default=None)
     p.add_argument("--block-reuse-timeout", type=float, default=300.0,
-                   help="cache-aware router: seconds a session's KV blocks "
-                        "are assumed to stay resident")
+                   help="cache-aware/disagg routers: seconds a session's KV "
+                        "blocks are assumed to stay resident")
+    p.add_argument("--static-backend-roles", default=None,
+                   help="comma-separated disagg roles "
+                        "(unified|prefill|decode), one per --static-backends "
+                        "entry (docs/DISAGG.md)")
+    p.add_argument("--kv-offload-url", default=None,
+                   help="shared KV offload store URL (kv://host:port) the "
+                        "disagg prefill->decode handoff rides; required and "
+                        "probed for reachability with --routing-logic disagg")
 
     p.add_argument("--engine-stats-interval", type=float, default=10.0)
     p.add_argument("--request-stats-window", type=float, default=60.0)
@@ -109,3 +117,52 @@ def validate_args(args: argparse.Namespace) -> None:
         raise ValueError(
             f"--session-key required with --routing-logic {args.routing_logic}"
         )
+    if args.routing_logic == "disagg":
+        # Disagg without a reachable offload store means EVERY request pays
+        # a doomed prefill hop before degrading to unified — fail fast at
+        # parse time instead (mirrors the session-key validation above).
+        if not getattr(args, "kv_offload_url", None):
+            raise ValueError(
+                "--kv-offload-url required with --routing-logic disagg "
+                "(the prefill->decode KV handoff rides the offload store)"
+            )
+        _probe_kv_offload_url(args.kv_offload_url)
+    if getattr(args, "static_backend_roles", None):
+        roles = [r.strip() for r in args.static_backend_roles.split(",")]
+        bad = [r for r in roles if r not in ("unified", "prefill", "decode")]
+        if bad:
+            raise ValueError(
+                f"--static-backend-roles entries must be unified|prefill|"
+                f"decode (got {bad})"
+            )
+        if args.service_discovery == "static" and args.static_backends and \
+                len(roles) != len(args.static_backends.split(",")):
+            raise ValueError(
+                "--static-backend-roles must list one role per "
+                "--static-backends entry"
+            )
+
+
+def _probe_kv_offload_url(url: str, timeout: float = 3.0) -> None:
+    """TCP-connect probe of the offload store. Uses RemoteKVClient's own
+    URL parser so the probe always resolves exactly the endpoint the
+    handoff plane will connect to. Unreachable -> error at parse time,
+    before the router starts taking traffic."""
+    import socket
+
+    from production_stack_tpu.kv_offload.remote import parse_kv_url
+
+    try:
+        host, port = parse_kv_url(url)
+    except ValueError as e:  # e.g. kv://host:notaport
+        raise ValueError(
+            f"--kv-offload-url {url!r} is malformed: {e}"
+        ) from e
+    try:
+        socket.create_connection((host, port), timeout=timeout).close()
+    except OSError as e:
+        raise ValueError(
+            f"--kv-offload-url {url!r} is not reachable ({e}); start the "
+            f"cache server (python -m production_stack_tpu.kv_offload.server) "
+            f"or fix the URL before enabling disagg routing"
+        ) from e

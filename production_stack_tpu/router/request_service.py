@@ -112,39 +112,61 @@ def _next_backend(endpoints, tried, resilience, request_like) -> Optional[str]:
 
 
 async def route_general_request(
-    request: web.Request, endpoint: str
+    request: web.Request, endpoint: str,
+    extra_headers: Optional[dict] = None,
+    pool=None,
+    request_like=None,
+    body_override: Optional[dict] = None,
+    deadline: Optional[Deadline] = None,
 ) -> web.StreamResponse:
     """Proxy `request` to the backend chosen by the routing logic, with
-    retry/failover on pre-stream failures and per-request deadlines."""
+    retry/failover on pre-stream failures and per-request deadlines.
+
+    The disagg flow reuses this loop for its decode hop and its
+    unified-fallback path: ``extra_headers`` ride every backend attempt,
+    ``pool`` restricts the candidates (role pools), ``request_like``
+    overrides the object handed to the routing policy, ``body_override``
+    supplies an already-policy-processed body (pre-request callbacks and
+    the rewriter are then NOT re-applied), and ``deadline`` carries the
+    caller's already-running budget instead of starting a fresh one."""
     app = request.app
     in_time = time.time()
-    try:
-        # A PII REDACT pass may have replaced the body (router/pii.py).
-        body_bytes = request.get("pii_redacted_body") or await request.read()
-        body = json.loads(body_bytes) if body_bytes else {}
-    except (json.JSONDecodeError, UnicodeDecodeError):
-        return _error(400, "Request body is not valid JSON")
+    if body_override is not None:
+        body = body_override
+    else:
+        try:
+            # A PII REDACT pass may have replaced the body (router/pii.py).
+            body_bytes = request.get("pii_redacted_body") \
+                or await request.read()
+            body = json.loads(body_bytes) if body_bytes else {}
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return _error(400, "Request body is not valid JSON")
     request_id = request.headers.get("x-request-id") or random_uuid("cmpl-")
 
-    callbacks = app.get("callbacks")
-    if callbacks is not None:
-        short = await callbacks.pre_request(request, body, endpoint)
-        if short is not None:
-            return short
+    if body_override is None:
+        callbacks = app.get("callbacks")
+        if callbacks is not None:
+            short = await callbacks.pre_request(request, body, endpoint)
+            if short is not None:
+                return short
 
     model = body.get("model")
     if not model:
         return _error(400, "Request body must contain a 'model' field")
 
-    rewriter = app.get("rewriter")
-    if rewriter is not None:
-        body = rewriter.rewrite(body, endpoint)
+    if body_override is None:
+        rewriter = app.get("rewriter")
+        if rewriter is not None:
+            body = rewriter.rewrite(body, endpoint)
 
-    endpoints = get_service_discovery().get_endpoint_info()
-    endpoints = [
-        ep for ep in endpoints
-        if not ep.model_names or model in ep.model_names
-    ]
+    if pool is not None:
+        endpoints = list(pool)
+    else:
+        endpoints = get_service_discovery().get_endpoint_info()
+        endpoints = [
+            ep for ep in endpoints
+            if not ep.model_names or model in ep.model_names
+        ]
     if not endpoints:
         return _error(
             404, f"Model '{model}' not served by any healthy backend",
@@ -153,8 +175,10 @@ async def route_general_request(
 
     resilience = get_resilience()
     cfg = _resilience_config()
-    deadline = Deadline.from_request(request.headers, cfg)
-    routed = RoutedRequest(request.headers, body)
+    if deadline is None:
+        deadline = Deadline.from_request(request.headers, cfg)
+    routed = request_like if request_like is not None \
+        else RoutedRequest(request.headers, body)
     payload = json.dumps(body).encode()
     tried: set = set()
     attempt = 0
@@ -199,6 +223,7 @@ async def route_general_request(
                     request, backend_url, endpoint, payload,
                     request_id, body=body, deadline=deadline,
                     traceparent=span.traceparent if span else None,
+                    extra_headers=extra_headers,
                 )
         except DeadlineExceeded as e:
             metrics.router_deadline_exceeded_total.labels(
@@ -241,6 +266,7 @@ async def proxy_request(
     body: Optional[dict] = None,
     traceparent: Optional[str] = None,
     deadline: Optional[Deadline] = None,
+    extra_headers: Optional[dict] = None,
 ) -> web.StreamResponse:
     """Stream the backend response through to the client.
 
@@ -265,6 +291,8 @@ async def proxy_request(
         headers["Authorization"] = auth
     if traceparent:
         headers["traceparent"] = traceparent
+    if extra_headers:
+        headers.update(extra_headers)
 
     def _fail(reason: str, status: Optional[int] = None) -> PreStreamFailure:
         monitor.on_request_complete(backend_url, request_id, time.time())
@@ -471,31 +499,42 @@ async def proxy_request(
 
 async def resilient_json_request(
     app, endpoint: str, body: dict, headers: Optional[dict] = None,
+    endpoints=None, request_like=None, deadline: Optional[Deadline] = None,
 ) -> dict:
     """One non-streaming request through routing + resilience, for callers
-    without an inbound web.Request (the batch processor). Retries/fails over
-    on connect errors and 502/503 like the proxy path; raises RuntimeError
-    once the retry budget is exhausted.
+    without an inbound web.Request (the batch processor, the disagg prefill
+    hop). Retries/fails over on connect errors and 502/503 like the proxy
+    path; raises RuntimeError once the retry budget is exhausted.
+    ``endpoints`` restricts the candidate pool (disagg role pools);
+    ``request_like`` overrides the object handed to the routing policy;
+    ``deadline`` bounds each attempt and the backoff sleeps with the
+    caller's remaining total budget (raises DeadlineExceeded).
 
     NOTE: keep breaker/metric semantics in sync with route_general_request /
     proxy_request above (same attempt loop over a different transport)."""
     import os
 
     model = body.get("model")
-    endpoints = [
-        ep for ep in get_service_discovery().get_endpoint_info()
-        if not ep.model_names or model in ep.model_names
-    ]
+    if endpoints is None:
+        endpoints = [
+            ep for ep in get_service_discovery().get_endpoint_info()
+            if not ep.model_names or model in ep.model_names
+        ]
     if not endpoints:
         raise RuntimeError(f"No backend serves model {model!r}")
     resilience = get_resilience()
     cfg = _resilience_config()
     session = app["client_session"]
-    routed = RoutedRequest(headers or {}, body)
-    # Forward auth + correlation id to the backend. Engines behind
+    routed = request_like if request_like is not None \
+        else RoutedRequest(headers or {}, body)
+    # Forward auth + correlation id to the backend, plus any disagg-plane
+    # x-pstpu-* headers (transfer key, endpoint kind). Engines behind
     # --api-key accept the shared VLLM_API_KEY (the discovery probe's
     # convention) when the caller supplies no Authorization of its own.
     fwd_headers = {}
+    for name, val in (headers or {}).items():
+        if name.lower().startswith("x-pstpu-"):
+            fwd_headers[name] = val
     for name in ("Authorization", "x-request-id"):
         val = (headers or {}).get(name) or (headers or {}).get(name.lower())
         if val:
@@ -508,6 +547,9 @@ async def resilient_json_request(
     last_failed_url: Optional[str] = None
     while attempt < max(1, cfg.retry_max_attempts):
         attempt += 1
+        rem = deadline.remaining_total() if deadline is not None else None
+        if rem is not None and rem <= 0:
+            raise DeadlineExceeded("total", last_failed_url or "routing")
         url = _next_backend(endpoints, tried, resilience, routed)
         if url is None:
             raise RuntimeError("All backends unavailable (circuit open)")
@@ -517,7 +559,8 @@ async def resilient_json_request(
         tried.add(url)
         if resilience is not None:
             resilience.on_dispatch(url)
-        try:
+
+        async def _attempt(url=url):
             async with session.post(
                 f"{url}{endpoint}", json=body, headers=fwd_headers
             ) as resp:
@@ -526,8 +569,13 @@ async def resilient_json_request(
                         url, f"backend returned {resp.status}",
                         status=resp.status,
                     )
-                status = resp.status
-                data = await resp.read()
+                return resp.status, await resp.read()
+
+        try:
+            status, data = await (
+                asyncio.wait_for(_attempt(), rem)
+                if rem is not None else _attempt()
+            )
             if resilience is not None:
                 # Same breaker semantics as the proxy path: relayed 5xx
                 # (e.g. a wedged backend's 500s) are failures, not successes.
@@ -536,7 +584,21 @@ async def resilient_json_request(
                 else:
                     resilience.record_success(url)
             return json.loads(data)
-        except (PreStreamFailure, *_CONNECT_ERRORS) as e:
+        except (PreStreamFailure, asyncio.TimeoutError,
+                *_CONNECT_ERRORS) as e:
+            if (
+                isinstance(e, asyncio.TimeoutError)
+                and not isinstance(e, aiohttp.ServerTimeoutError)
+                and rem is not None
+            ):
+                # Our wait_for deadline fired: the caller's budget ran out
+                # mid-attempt and a wedged backend must not hold the
+                # request past it. aiohttp's OWN socket timeouts subclass
+                # asyncio.TimeoutError too but are ordinary retryable
+                # backend failures — they take the branch below.
+                if resilience is not None:
+                    resilience.record_failure(url)
+                raise DeadlineExceeded("total", url) from None
             last_error = e
             last_failed_url = url
             if resilience is not None:
@@ -544,7 +606,190 @@ async def resilient_json_request(
             logger.warning("Batch request to %s failed: %s", url, e)
             if attempt < max(1, cfg.retry_max_attempts):
                 metrics.router_retries_total.labels(server=url).inc()
-                await asyncio.sleep(backoff_delay(attempt, cfg))
+                delay = backoff_delay(attempt, cfg)
+                rem = deadline.remaining_total() \
+                    if deadline is not None else None
+                if rem is not None and rem <= delay:
+                    raise DeadlineExceeded("total", url) from None
+                await asyncio.sleep(delay)
     raise RuntimeError(
         f"Backend request failed after {attempt} attempt(s): {last_error}"
     )
+
+
+# ---------------------------------------------------------------- disagg flow
+def _disagg_eligible(body: dict, endpoint: str) -> bool:
+    """Only single-choice, single-prompt generation requests take the
+    two-hop path; fan-outs, tool calling, and multi-prompt batches stay on
+    the unified path (the handoff manifest carries exactly one stream)."""
+    if not endpoint.endswith("/completions"):
+        return False
+    if (body.get("n") or 1) != 1 or (body.get("best_of") or 1) != 1:
+        return False
+    if body.get("tools"):
+        return False
+    if not endpoint.endswith("chat/completions"):
+        p = body.get("prompt")
+        if isinstance(p, list):
+            # A single list of token ids is fine; lists of strings/lists
+            # are multi-prompt fan-outs.
+            if not (p and all(type(x) is int for x in p)):
+                return False
+        elif not isinstance(p, str):
+            return False
+    return True
+
+
+async def route_disagg_request(
+    request: web.Request, endpoint: str
+) -> web.StreamResponse:
+    """Two-hop disaggregated flow (docs/DISAGG.md):
+
+      1. prefill hop — non-streaming POST /disagg/prefill to the
+         least-loaded prefill engine (resilient_json_request: retry +
+         failover + breaker); the engine prefills, samples token 1, and
+         publishes KV + chain state under a router-minted transfer key.
+      2. decode hop — the original request streams from a decode engine
+         picked by cache affinity, carrying the transfer key; the engine
+         rehydrates the KV and continues from token 1, so the client sees
+         ONE ordinary (SSE or JSON) response.
+
+    Any failure — ineligible request, empty role pool, prefill publish
+    error, decode pool exhausted — degrades to unified serving: the
+    request is re-routed as a plain single-hop request carrying the
+    fallback header that unlocks end-to-end serving on role-split engines.
+    Never an error while any engine can still serve."""
+    from production_stack_tpu.disagg.transfer import (
+        DISAGG_ENDPOINT_HEADER,
+        DISAGG_FALLBACK_HEADER,
+        DISAGG_KEY_HEADER,
+        DISAGG_ROLE_HEADER,
+    )
+    from production_stack_tpu.router.routing_logic import DisaggRouter
+
+    app = request.app
+    cfg = _resilience_config()
+    deadline = Deadline.from_request(request.headers, cfg)
+    body: dict = {}
+
+    async def fallback(reason: str) -> web.StreamResponse:
+        metrics.router_disagg_fallbacks_total.labels(reason=reason).inc()
+        logger.info("Disagg request degrading to unified serving (%s)",
+                    reason)
+        # body_override: policy (callbacks/rewriter) already ran below and
+        # must not re-apply; the deadline budget keeps running.
+        return await route_general_request(
+            request, endpoint, extra_headers={DISAGG_FALLBACK_HEADER: "1"},
+            body_override=body, deadline=deadline,
+        )
+
+    try:
+        body_bytes = request.get("pii_redacted_body") or await request.read()
+        body = json.loads(body_bytes) if body_bytes else {}
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        return _error(400, "Request body is not valid JSON")
+    # Same pre-request policy surface as route_general_request: the
+    # callbacks short-circuit and the rewriter must not be bypassable by
+    # the routing mode. They run exactly ONCE — the fallback path hands
+    # the processed body onward via body_override, which tells
+    # route_general_request to skip both.
+    callbacks = app.get("callbacks")
+    if callbacks is not None:
+        short = await callbacks.pre_request(request, body, endpoint)
+        if short is not None:
+            return short
+    model = body.get("model")
+    if not model:
+        return _error(400, "Request body must contain a 'model' field")
+    rewriter = app.get("rewriter")
+    if rewriter is not None:
+        body = rewriter.rewrite(body, endpoint)
+    logic = get_routing_logic()
+    if not isinstance(logic, DisaggRouter):
+        return await route_general_request(request, endpoint)
+    endpoints = [
+        ep for ep in get_service_discovery().get_endpoint_info()
+        if not ep.model_names or model in ep.model_names
+    ]
+    if not endpoints:
+        return _error(
+            404, f"Model '{model}' not served by any healthy backend",
+            etype="model_not_found",
+        )
+    engine_stats = get_engine_stats_scraper().get_engine_stats()
+    pools = logic.split_pools(endpoints, engine_stats)
+    if not _disagg_eligible(body, endpoint):
+        return await fallback("ineligible")
+    resilience = get_resilience()
+
+    def _alive(pool):
+        return [ep for ep in pool
+                if resilience is None or resilience.allow(ep.url)]
+
+    if not _alive(pools["prefill"]) or not _alive(pools["decode"]):
+        return await fallback("pool_empty")
+
+    request_id = request.headers.get("x-request-id") or random_uuid("cmpl-")
+    key = f"pstpu-transfer:{random_uuid(request_id + ':')}"
+    kind = "chat" if endpoint.endswith("chat/completions") else "completions"
+
+    # ------------------------------------------------------------- hop 1
+    # The deadline budget (constructed at request entry, above) spans BOTH
+    # hops: the prefill hop spends from it and the decode hop gets only
+    # the remainder — a per-hop clock would let the total run to 2x the
+    # promised bound.
+    hop1 = RoutedRequest(request.headers, body)
+    hop1.disagg_hop = "prefill"
+    hop1_headers = {
+        DISAGG_KEY_HEADER: key,
+        DISAGG_ENDPOINT_HEADER: kind,
+        "x-request-id": request_id,
+    }
+    auth = request.headers.get("Authorization")
+    if auth:
+        hop1_headers["Authorization"] = auth
+    try:
+        pre = await resilient_json_request(
+            app, "/disagg/prefill", body, headers=hop1_headers,
+            endpoints=pools["prefill"], request_like=hop1,
+            deadline=deadline,
+        )
+    except DeadlineExceeded as e:
+        metrics.router_deadline_exceeded_total.labels(
+            server=e.backend_url, kind=e.kind
+        ).inc()
+        return _error(
+            504, "Request total deadline exceeded",
+            etype="deadline_exceeded",
+        )
+    except (RuntimeError, ValueError) as e:
+        # RuntimeError: retry budget exhausted. ValueError (incl.
+        # JSONDecodeError): a 200 with a non-JSON body (interposed proxy) —
+        # either way the hop failed, so degrade, never 500.
+        logger.warning("Disagg prefill hop failed: %s", e)
+        return await fallback("prefill_failed")
+    if pre.get("status") != "handoff":
+        logger.warning("Disagg prefill hop refused: %s", pre)
+        return await fallback("prefill_refused")
+    metrics.router_disagg_handoffs_total.inc()
+
+    # ------------------------------------------------------------- hop 2
+    # The general attempt loop does the heavy lifting (retry/failover/
+    # breaker/deadline/tracing) against the decode pool; its own policy
+    # hooks are skipped via body_override (they ran above).
+    hop2 = RoutedRequest(request.headers, body)
+    hop2.disagg_hop = "decode"
+    resp = await route_general_request(
+        request, endpoint,
+        extra_headers={DISAGG_ROLE_HEADER: "decode", DISAGG_KEY_HEADER: key},
+        pool=pools["decode"], request_like=hop2, body_override=body,
+        deadline=deadline,
+    )
+    if resp.status in (502, 503) and not resp.prepared:
+        # Loop-generated failure (decode pool down/exhausted), nothing on
+        # the wire yet: the transfer may or may not have been consumed —
+        # unified fallback recomputes the prefill, which is wasteful but
+        # correct (deterministic per-sequence sampling). Backend-relayed
+        # 502/503s never reach here (RETRYABLE_STATUSES are retried).
+        return await fallback("decode_failed")
+    return resp

@@ -33,6 +33,7 @@ class RoutingLogic:
     ROUND_ROBIN = "roundrobin"
     SESSION = "session"
     CACHE_AWARE_LB = "cache_aware_load_balancing"
+    DISAGG = "disagg"
 
 
 class RoutingInterface(metaclass=SingletonABCMeta):
@@ -226,10 +227,114 @@ class CacheAwareLoadBalancingRouter(RoutingInterface):
         return best_url
 
 
+class DisaggRouter(RoutingInterface):
+    """Two-hop prefill/decode disaggregation routing (docs/DISAGG.md;
+    DistServe OSDI'24 / Splitwise ISCA'24 shape).
+
+    Endpoints are split into role pools (prefill/decode/unified) from
+    EndpointInfo.role (static flag / k8s pod label) with the scraped
+    ``pstpu:disagg_role`` metric as fallback. Hop 1 (prefill) goes to the
+    least-loaded prefill engine — prefill is compute-bound, so load is the
+    only signal. Hop 2 (decode) prefers the engine already holding the
+    session's KV (affinity map with TTL, like the cache-aware router) and
+    otherwise takes the least-loaded decode engine. The two-hop
+    orchestration itself lives in request_service.route_disagg_request;
+    this class only makes the per-hop picks (the ``request`` object's
+    ``disagg_hop`` attribute selects which)."""
+
+    def __init__(
+        self,
+        session_key: Optional[str] = None,
+        block_reuse_timeout: float = 300.0,
+        **_,
+    ):
+        if hasattr(self, "_initialized"):
+            return
+        self._initialized = True
+        self.session_key = session_key
+        self.block_reuse_timeout = block_reuse_timeout
+        # session -> (decode_engine_url, last_seen_ts)
+        self._affinity = LRUCache(capacity=8192)
+        self._rr = 0
+
+    # ----------------------------------------------------------------- pools
+    @staticmethod
+    def endpoint_role(ep, engine_stats: Dict[str, EngineStats]) -> str:
+        role = getattr(ep, "role", None)
+        if not role:
+            es = engine_stats.get(ep.url)
+            role = getattr(es, "role", "") if es is not None else ""
+        # Unknown/typo'd roles count as unified rather than orphaning the
+        # endpoint into a pool nothing reads.
+        return role if role in ("prefill", "decode") else "unified"
+
+    def split_pools(self, endpoints, engine_stats) -> Dict[str, list]:
+        pools: Dict[str, list] = {"prefill": [], "decode": [], "unified": []}
+        for ep in endpoints:
+            pools[self.endpoint_role(ep, engine_stats)].append(ep)
+        return pools
+
+    # ----------------------------------------------------------------- picks
+    def _least_loaded(self, endpoints, engine_stats, request_stats) -> str:
+        best_url, best = None, float("inf")
+        for ep in sorted(endpoints, key=lambda e: e.url):
+            load = CacheAwareLoadBalancingRouter._engine_load_score(
+                ep.url, engine_stats, request_stats
+            )
+            if load < best:
+                best_url, best = ep.url, load
+        if best_url is None:  # defensive; endpoints is never empty here
+            best_url = endpoints[self._rr % len(endpoints)].url
+            self._rr += 1
+        return best_url
+
+    def _session_id(self, request):
+        headers = getattr(request, "headers", None)
+        if headers is None or not self.session_key:
+            return None
+        return headers.get(self.session_key)
+
+    def pick_prefill(self, endpoints, engine_stats, request_stats,
+                     request) -> str:
+        return self._least_loaded(endpoints, engine_stats, request_stats)
+
+    def pick_decode(self, endpoints, engine_stats, request_stats,
+                    request) -> str:
+        session_id = self._session_id(request)
+        if session_id is not None:
+            entry = self._affinity.get(session_id)
+            if entry is not None and \
+                    time.time() - entry[1] < self.block_reuse_timeout:
+                for ep in endpoints:
+                    if ep.url == entry[0]:
+                        self._affinity.put(session_id, (ep.url, time.time()))
+                        return ep.url
+        url = self._least_loaded(endpoints, engine_stats, request_stats)
+        if session_id is not None:
+            self._affinity.put(session_id, (url, time.time()))
+        return url
+
+    # --------------------------------------------------------------- routing
+    def route_request(self, endpoints, engine_stats, request_stats,
+                      request) -> str:
+        if not endpoints:
+            raise ValueError("No available endpoints for routing")
+        hop = getattr(request, "disagg_hop", None)
+        if hop == "prefill":
+            return self.pick_prefill(endpoints, engine_stats, request_stats,
+                                     request)
+        if hop == "decode":
+            return self.pick_decode(endpoints, engine_stats, request_stats,
+                                    request)
+        # Generic traffic (embeddings, unified fallback): least-loaded.
+        return self._least_loaded(endpoints, engine_stats, request_stats)
+
+
 _ROUTERS = {
     RoutingLogic.ROUND_ROBIN: RoundRobinRouter,
     RoutingLogic.SESSION: SessionRouter,
     RoutingLogic.CACHE_AWARE_LB: CacheAwareLoadBalancingRouter,
+    RoutingLogic.DISAGG: DisaggRouter,
 }
 
 

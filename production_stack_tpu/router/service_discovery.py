@@ -35,6 +35,10 @@ class EndpointInfo:
     model_names: List[str] = field(default_factory=list)
     added_timestamp: float = field(default_factory=time.time)
     pod_name: Optional[str] = None
+    # Disagg role (unified|prefill|decode) from --static-backend-roles or
+    # the pod's pstpu-role label; None = unknown (the DisaggRouter falls
+    # back to the scraped pstpu:disagg_role metric, then "unified").
+    role: Optional[str] = None
 
     # Back-compat alias: parts of the reference treat this as a single name
     # (reference service_discovery.py:30-47 stores `model_name`).
@@ -55,13 +59,18 @@ class ServiceDiscovery:
 
 
 class StaticServiceDiscovery(ServiceDiscovery):
-    """Fixed backend list from --static-backends/--static-models."""
+    """Fixed backend list from --static-backends/--static-models
+    (+ optional --static-backend-roles for disagg pools)."""
 
-    def __init__(self, urls: List[str], models: List[List[str]]):
+    def __init__(self, urls: List[str], models: List[List[str]],
+                 roles: Optional[List[Optional[str]]] = None):
         assert len(urls) == len(models), (urls, models)
+        if roles is not None:
+            assert len(roles) == len(urls), (urls, roles)
         self._endpoints = [
-            EndpointInfo(url=u, model_names=list(m))
-            for u, m in zip(urls, models)
+            EndpointInfo(url=u, model_names=list(m),
+                         role=(roles[i] if roles else None))
+            for i, (u, m) in enumerate(zip(urls, models))
         ]
 
     def get_endpoint_info(self) -> List[EndpointInfo]:
@@ -239,13 +248,27 @@ class K8sPodIPServiceDiscovery(ServiceDiscovery):
         models = (
             await self._probe_models(session, url) if self.probe_models else []
         )
+        # Disagg role from the pod label the Helm chart stamps on role-split
+        # engine deployments (helm/templates/deployment-engine.yaml). A
+        # typo'd label must not silently orphan the pod into a nonexistent
+        # pool (every request would then take the pool_empty fallback).
+        role = ((meta.get("labels") or {}).get("pstpu-role") or "") \
+            .strip().lower() or None
+        if role is not None and role not in ("unified", "prefill", "decode"):
+            logger.warning(
+                "Pod %s has invalid pstpu-role label %r; treating as "
+                "role-less (scraped pstpu:disagg_role may still apply)",
+                name, role,
+            )
+            role = None
         with self._lock:
             known = self._endpoints.get(name)
-            if known is None or known.url != url or known.model_names != models:
-                logger.info("Discovery: adding engine %s at %s (%s)",
-                            name, url, models)
+            if known is None or known.url != url \
+                    or known.model_names != models or known.role != role:
+                logger.info("Discovery: adding engine %s at %s (%s, role=%s)",
+                            name, url, models, role)
                 self._endpoints[name] = EndpointInfo(
-                    url=url, model_names=models, pod_name=name
+                    url=url, model_names=models, pod_name=name, role=role
                 )
 
     # -------------------------------------------------------------- interface
@@ -271,7 +294,7 @@ def initialize_service_discovery(kind: str, **kwargs) -> ServiceDiscovery:
         _service_discovery.close()
     if kind == "static":
         _service_discovery = StaticServiceDiscovery(
-            kwargs["urls"], kwargs["models"]
+            kwargs["urls"], kwargs["models"], roles=kwargs.get("roles")
         )
     elif kind == "k8s":
         _service_discovery = K8sPodIPServiceDiscovery(

@@ -31,11 +31,17 @@ class EngineStats:
     gpu_prefix_cache_hit_rate: float = 0.0   # per-interval (delta-based)
     gpu_cache_usage_perc: float = 0.0        # TPU: HBM KV-pool usage
     num_preemptions: int = 0
+    # Disagg role scraped from pstpu:disagg_role{role="..."} — the
+    # DisaggRouter's pool-split fallback when discovery carries no role.
+    role: str = ""
 
     @staticmethod
     def from_prometheus_text(text: str, prev: Optional[Tuple[float, float]] = None):
         """Parse exposition text; returns (EngineStats, (hits, queries))."""
+        import re
+
         values: Dict[str, float] = {}
+        role = ""
         for line in text.splitlines():
             if not line or line.startswith("#"):
                 continue
@@ -43,6 +49,11 @@ class EngineStats:
             if len(parts) < 2:
                 continue
             name = parts[0].split("{")[0]
+            if name == "pstpu:disagg_role":
+                m = re.search(r'role="([^"]*)"', parts[0])
+                if m and parts[-1] not in ("0", "0.0"):
+                    role = m.group(1)
+                continue
             try:
                 values[name] = float(parts[-1])
             except ValueError:
@@ -62,6 +73,7 @@ class EngineStats:
             gpu_prefix_cache_hit_rate=hit_rate,
             gpu_cache_usage_perc=values.get("vllm:gpu_cache_usage_perc", 0.0),
             num_preemptions=int(values.get("vllm:num_preemptions_total", 0)),
+            role=role,
         )
         return stats, (hits, queries)
 

@@ -17,6 +17,13 @@ from typing import Optional
 
 from aiohttp import web
 
+from production_stack_tpu.disagg.transfer import (
+    DISAGG_ENDPOINT_HEADER,
+    DISAGG_FALLBACK_HEADER,
+    DISAGG_KEY_HEADER,
+    DISAGG_ROLE_HEADER,
+    ENGINE_ROLES,
+)
 from production_stack_tpu.engine.config import EngineConfig
 from production_stack_tpu.engine.engine import ServingEngine
 from production_stack_tpu.engine.sampling import SamplingParams
@@ -172,6 +179,7 @@ class APIServer:
         @web.middleware
         async def auth(request: web.Request, handler):
             if self.api_key and (request.path.startswith("/v1")
+                                 or request.path.startswith("/disagg")
                                  or request.path == "/rerank"):
                 import hmac
 
@@ -186,7 +194,9 @@ class APIServer:
         async def admission(request: web.Request, handler):
             # Drain gate + in-flight accounting for every serving endpoint.
             if request.method != "POST" or not (
-                request.path.startswith("/v1") or request.path == "/rerank"
+                request.path.startswith("/v1")
+                or request.path.startswith("/disagg")
+                or request.path == "/rerank"
             ):
                 return await handler(request)
             if self._draining:
@@ -219,6 +229,7 @@ class APIServer:
         app.on_cleanup.append(on_cleanup)
         app.router.add_post("/v1/chat/completions", self.chat_completions)
         app.router.add_post("/v1/completions", self.completions)
+        app.router.add_post("/disagg/prefill", self.disagg_prefill)
         app.router.add_post("/v1/embeddings", self.embeddings)
         app.router.add_post("/v1/rerank", self.rerank)
         app.router.add_post("/rerank", self.rerank)
@@ -330,8 +341,177 @@ class APIServer:
     async def version(self, request: web.Request) -> web.Response:
         return web.json_response({"version": VERSION})
 
+    # ----------------------------------------------------- disagg (role split)
+    def _role_gate(self, request: web.Request):
+        """503 generation requests a role-split engine must not serve
+        end-to-end, unless the router flagged them as degrade-to-unified
+        fallback (or they are the decode hop this engine exists for). 503
+        is retryable, so a misrouted request fails over cleanly."""
+        role = self.engine.config.role
+        if role == "unified" or request.headers.get(DISAGG_FALLBACK_HEADER):
+            return None
+        if role == "decode" and \
+                request.headers.get(DISAGG_ROLE_HEADER) == "decode":
+            return None
+        return _error(
+            503,
+            f"Engine serves disagg role {role!r}; plain generation requests "
+            f"must go to the unified pool (or carry "
+            f"{DISAGG_FALLBACK_HEADER})",
+            etype="wrong_role", headers={"Retry-After": "1"},
+        )
+
+    async def _fetch_handoff(self, request: web.Request):
+        """(manifest, error_response) for a decode-hop request; (None, None)
+        when the request is not a decode hop."""
+        if request.headers.get(DISAGG_ROLE_HEADER) != "decode":
+            return None, None
+        if self.engine.disagg is None:
+            return None, _error(
+                503, "This engine has no disagg coordinator (--role)",
+                etype="wrong_role", headers={"Retry-After": "1"},
+            )
+        key = request.headers.get(DISAGG_KEY_HEADER)
+        if not key:
+            return None, _error(400, f"{DISAGG_KEY_HEADER} header required")
+        loop = asyncio.get_running_loop()
+        mani = await loop.run_in_executor(
+            None, self.engine.disagg.fetch_handoff, key
+        )
+        if mani is None:
+            # Missing/expired/unreachable: retryable — the router fails over
+            # within the decode pool or degrades to unified serving.
+            return None, _error(
+                503, f"Handoff transfer {key!r} unavailable",
+                etype="handoff_unavailable", headers={"Retry-After": "1"},
+            )
+        cfg = self.engine.config
+        if mani.finish_reason is None and (
+            mani.block_size != cfg.block_size
+            or mani.num_blocks > self.engine.block_manager.num_blocks - 1
+            or len(mani.prompt_token_ids) >= cfg.max_model_len
+        ):
+            # Misconfigured pools (KV layout/capacity mismatch): fail
+            # pre-stream and retryable so the router degrades to unified.
+            # The lease is NOT consumed — the bundle stays available for a
+            # compatible engine (or LRU), instead of every retry seeing
+            # "unavailable" because the first incompatible engine ate it.
+            return None, _error(
+                503, "Handoff bundle incompatible with this engine's KV "
+                     "layout/capacity",
+                etype="handoff_incompatible", headers={"Retry-After": "1"},
+            )
+        # Accepted: consume the delete-after-consume lease now, before the
+        # restore — a crash mid-restore leaves a missing bundle, which the
+        # router's retry turns into a unified-fallback recompute (correct).
+        await loop.run_in_executor(
+            None, self.engine.disagg.consume_handoff, key
+        )
+        return mani, None
+
+    async def disagg_prefill(self, request: web.Request) -> web.Response:
+        """Hop 1 of the disaggregated flow (router-internal, non-streaming):
+        prefill the prompt, sample token 1, publish KV + chain state under
+        the transfer key, and report the outcome. The client-visible stream
+        comes from the decode hop."""
+        if self.engine.disagg is None:
+            return _error(
+                501, "Disagg handoff disabled (--role unified)",
+                etype="wrong_role",
+            )
+        if self.engine.config.role == "decode":
+            return _error(
+                503, "Engine serves disagg role 'decode'; prefill hops "
+                     "belong to the prefill pool",
+                etype="wrong_role", headers={"Retry-After": "1"},
+            )
+        key = request.headers.get(DISAGG_KEY_HEADER)
+        if not key:
+            return _error(400, f"{DISAGG_KEY_HEADER} header required")
+        kind = request.headers.get(DISAGG_ENDPOINT_HEADER, "completions")
+        try:
+            body = json.loads(await request.read())
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return _error(400, "Request body is not valid JSON")
+        model = body.get("model", self.model_name)
+        if model != self.model_name:
+            return _error(404, f"Model '{model}' not found",
+                          etype="model_not_found")
+        # Same parameter surface as the unified handlers: silently dropping
+        # e.g. logit_bias only on the disagg path would make behavior
+        # depend on the routing mode.
+        err = self._check_unsupported(body, chat=(kind == "chat"))
+        if err is not None:
+            return err
+        if kind == "chat":
+            messages = body.get("messages")
+            if not messages:
+                return _error(400, "'messages' is required")
+            try:
+                prompt = self.engine.tokenizer.apply_chat_template(
+                    messages, add_generation_prompt=True
+                )
+            except Exception as e:  # noqa: BLE001 — malformed messages
+                return _error(400, f"Could not apply chat template: {e}")
+            sampling = SamplingParams.from_request(
+                body, default_max_tokens=256
+            )
+            submit = {"prompt": prompt}
+        else:
+            prompt = body.get("prompt")
+            if isinstance(prompt, list) and prompt and all(
+                type(x) is int for x in prompt
+            ):
+                # Same out-of-vocab guard as completions(): a bad id would
+                # otherwise clamp silently or abort co-batched prompts.
+                vocab = self.engine.tokenizer.vocab_size
+                if any(not 0 <= t < vocab for t in prompt):
+                    return _error(
+                        400, f"prompt token ids must be in [0, {vocab})",
+                    )
+                submit = {"prompt_token_ids": list(prompt)}
+            elif isinstance(prompt, str):
+                submit = {"prompt": prompt}
+            else:
+                return _error(
+                    400, "disagg prefill requires a single string prompt "
+                         "or one list of token ids",
+                )
+            sampling = SamplingParams.from_request(
+                body, default_max_tokens=16
+            )
+        request_id = request.headers.get("x-request-id") \
+            or random_uuid("cmpl-")
+        final = None
+        try:
+            async for out in self.engine.generate(
+                **submit, sampling=sampling, request_id=request_id,
+                handoff_key=key,
+            ):
+                final = out
+        except ValueError as e:
+            return _error(400, str(e))
+        if final is None or final.finish_reason == "abort":
+            # Publish failed (or the engine aborted): retryable so the
+            # router falls back to unified serving instead of erroring.
+            return _error(
+                503, "KV handoff publish failed",
+                etype="handoff_failed", headers={"Retry-After": "1"},
+            )
+        return web.json_response({
+            "status": "handoff",
+            "key": key,
+            "finished": final.finish_reason != "handoff",
+            "finish_reason": final.finish_reason,
+            "prompt_tokens": final.num_prompt_tokens,
+            "cached_tokens": final.num_cached_tokens,
+        })
+
     # ------------------------------------------------------------ completions
     async def chat_completions(self, request: web.Request) -> web.StreamResponse:
+        gate = self._role_gate(request)
+        if gate is not None:
+            return gate
         try:
             body = json.loads(await request.read())
         except (json.JSONDecodeError, UnicodeDecodeError):
@@ -369,11 +549,19 @@ class APIServer:
             # JSON prefix (tool_calling.py module docstring).
             prompt += tool_ctx.forced_prefix
         sampling = SamplingParams.from_request(body, default_max_tokens=256)
+        handoff, herr = await self._fetch_handoff(request)
+        if herr is not None:
+            return herr
         return await self._generate_response(
-            request, body, [prompt], sampling, chat=True, tool_ctx=tool_ctx
+            request, body, [prompt], sampling, chat=True, tool_ctx=tool_ctx,
+            handoff=handoff,
+            fallback=bool(request.headers.get(DISAGG_FALLBACK_HEADER)),
         )
 
     async def completions(self, request: web.Request) -> web.StreamResponse:
+        gate = self._role_gate(request)
+        if gate is not None:
+            return gate
         try:
             body = json.loads(await request.read())
         except (json.JSONDecodeError, UnicodeDecodeError):
@@ -428,8 +616,12 @@ class APIServer:
         if err is not None:
             return err
         sampling = SamplingParams.from_request(body, default_max_tokens=16)
+        handoff, herr = await self._fetch_handoff(request)
+        if herr is not None:
+            return herr
         return await self._generate_response(
-            request, body, prompts, sampling, chat=False
+            request, body, prompts, sampling, chat=False, handoff=handoff,
+            fallback=bool(request.headers.get(DISAGG_FALLBACK_HEADER)),
         )
 
     @staticmethod
@@ -551,6 +743,7 @@ class APIServer:
     async def _generate_response(
         self, request: web.Request, body: dict, prompts: list,
         sampling: SamplingParams, chat: bool, tool_ctx=None,
+        handoff=None, fallback: bool = False,
     ) -> web.StreamResponse:
         """Run len(prompts) * sampling.n generations and render them as
         OpenAI choices (prompt-major indexing), streaming or not. The
@@ -617,13 +810,26 @@ class APIServer:
 
         lora = self._lora_name(body)
 
+        if handoff is not None and num_choices != 1:
+            # The router's eligibility check keeps fan-outs on the unified
+            # path; a hop that slips through anyway must fail loudly.
+            return _error(400, "disagg decode hop requires n=1 and a "
+                               "single prompt")
+
         def submit_kwargs(p):
             # Token-id prompts go to the engine as ids (no decode->encode
             # roundtrip — advisor r4 medium #2).
-            return (
+            kw = (
                 {"prompt_token_ids": p} if isinstance(p, list)
                 else {"prompt": p}
             )
+            if handoff is not None:
+                # The manifest's token ids are authoritative; the prompt in
+                # kw is ignored by the engine's restore path.
+                kw["handoff_state"] = handoff
+            if fallback:
+                kw["disagg_fallback"] = True
+            return kw
 
         if stream:
             response = web.StreamResponse(
@@ -892,6 +1098,9 @@ def build_engine_from_args(args: argparse.Namespace) -> ServingEngine:
         overlap_dispatch=not args.no_overlap_dispatch,
         pipeline_depth=args.pipeline_depth,
         lora_modules=_parse_lora_modules(args.lora_modules),
+        role=args.role,
+        **({"kv_remote_url": args.kv_remote_url}
+           if args.kv_remote_url else {}),
     )
     return ServingEngine(cfg)
 
@@ -936,6 +1145,17 @@ def parse_args(argv=None) -> argparse.Namespace:
                    metavar="NAME=PATH",
                    help="LoRA adapters to serve (vLLM convention): "
                         "requests with model=NAME get base + adapter")
+    p.add_argument("--role", default="unified", choices=list(ENGINE_ROLES),
+                   help="prefill/decode disaggregation role "
+                        "(docs/DISAGG.md): 'prefill' computes prompt KV + "
+                        "token 1 and publishes them to the remote KV store; "
+                        "'decode' rehydrates published KV and continues the "
+                        "stream; non-unified roles require --kv-remote-url "
+                        "or LMCACHE_REMOTE_URL")
+    p.add_argument("--kv-remote-url", default=None,
+                   help="shared KV store URL (kv://host:port) for the "
+                        "offload tier and the disagg handoff plane "
+                        "(defaults to $LMCACHE_REMOTE_URL)")
     import os
 
     p.add_argument("--api-key", default=os.environ.get("VLLM_API_KEY"),

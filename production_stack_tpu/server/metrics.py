@@ -19,8 +19,11 @@ def render_engine_metrics(engine: "ServingEngine", model_name: str) -> str:
     # Dispatch-pipeline telemetry keys default to 0 so protocol-faithful
     # fakes (tests) that predate them still render.
     for key in ("decode_dispatches_total", "prefill_dispatches_total",
-                "dispatch_overlap_ratio", "dispatch_gap_seconds_total"):
+                "dispatch_overlap_ratio", "dispatch_gap_seconds_total",
+                "kv_handoffs_total", "kv_handoff_bytes_total",
+                "kv_handoff_seconds_total", "kv_handoff_failures_total"):
         s.setdefault(key, 0)
+    s.setdefault("disagg_role", "unified")
     label = f'{{model_name="{model_name}"}}'
     lines = [
         "# HELP vllm:num_requests_running Running requests",
@@ -68,6 +71,32 @@ def render_engine_metrics(engine: "ServingEngine", model_name: str) -> str:
         "# TYPE pstpu:dispatch_gap_seconds_total counter",
         f"pstpu:dispatch_gap_seconds_total{label} "
         f"{s['dispatch_gap_seconds_total']:.6f}",
+        # Prefill/decode disaggregation (docs/DISAGG.md): the engine's role
+        # (the router's DisaggRouter reads it to build pools) and the KV
+        # handoff plane's transfer telemetry — publishes on prefill
+        # engines, consumes on decode engines.
+        "# HELP pstpu:disagg_role Engine disaggregation role (1 = active)",
+        "# TYPE pstpu:disagg_role gauge",
+        f'pstpu:disagg_role{{model_name="{model_name}",'
+        f'role="{s["disagg_role"]}"}} 1',
+        "# HELP pstpu:kv_handoffs_total Completed KV handoff transfers "
+        "(published or consumed)",
+        "# TYPE pstpu:kv_handoffs_total counter",
+        f"pstpu:kv_handoffs_total{label} {s['kv_handoffs_total']}",
+        "# HELP pstpu:kv_handoff_bytes_total Bytes moved through the KV "
+        "handoff plane",
+        "# TYPE pstpu:kv_handoff_bytes_total counter",
+        f"pstpu:kv_handoff_bytes_total{label} {s['kv_handoff_bytes_total']}",
+        "# HELP pstpu:kv_handoff_seconds_total Seconds spent serializing/"
+        "publishing/consuming KV handoffs",
+        "# TYPE pstpu:kv_handoff_seconds_total counter",
+        f"pstpu:kv_handoff_seconds_total{label} "
+        f"{s['kv_handoff_seconds_total']:.6f}",
+        "# HELP pstpu:kv_handoff_failures_total Failed KV handoff "
+        "transfers",
+        "# TYPE pstpu:kv_handoff_failures_total counter",
+        f"pstpu:kv_handoff_failures_total{label} "
+        f"{s['kv_handoff_failures_total']}",
     ]
     # TTFT / e2e latency distributions (the reference dashboard's two
     # distribution panels query these bucket series).
