@@ -181,7 +181,6 @@ class ServingEngine:
         self.start_time = time.monotonic()
         self.prompt_tokens_total = 0
         self.generation_tokens_total = 0
-        self.offload_blocks_resident = 0
         self.last_step_time = time.monotonic()
         # TTFT + e2e latency histograms (the reference dashboard's two
         # distribution panels chart these exact series — VERDICT r4 #5).
@@ -217,6 +216,15 @@ class ServingEngine:
         if self._dispatch_log is not None:
             self._dispatch_log.close()
             self._dispatch_log = None
+
+    @property
+    def offload_blocks_resident(self) -> int:
+        """KV blocks currently resident in the host offload pool — the live
+        count behind the pstpu:kv_offload_blocks gauge on BOTH metrics
+        renderers (a stored counter here drifted to a permanent 0)."""
+        if self.offload is None or self.offload.host_pool is None:
+            return 0
+        return self.offload.host_pool.stats()["entries"]
 
     @property
     def is_healthy(self) -> bool:
@@ -840,6 +848,8 @@ class ServingEngine:
         return {
             "disagg_role": self.config.role,
             **disagg,
+            "engine_uptime_seconds": time.monotonic() - self.start_time,
+            "kv_offload_blocks": self.offload_blocks_resident,
             "num_requests_running": self.scheduler.num_running,
             "num_requests_waiting": self.scheduler.num_waiting,
             "kv_cache_usage": self.block_manager.usage(),

@@ -81,6 +81,33 @@ class EngineMetricsCollector(Collector):
                       "Cumulative host-observed time with NO dispatch "
                       "outstanding between two dispatches (pipeline bubble)",
                       eng.dispatch_gap_seconds_total)
+        # Prefill/decode disaggregation telemetry — the text renderer
+        # (server/metrics.py) exports the same series; keeping the two
+        # renderers aligned is enforced by pstpu-lint PL004.
+        role = getattr(eng.config, "role", "unified") or "unified"
+        role_g = GaugeMetricFamily(
+            "pstpu:disagg_role",
+            "Engine disaggregation role (1 = active)",
+            labels=["model_name", "role"],
+        )
+        role_g.add_metric([eng.config.model_name, role], 1)
+        yield role_g
+        disagg = getattr(eng, "disagg", None)
+        d = disagg.stats() if disagg is not None else {}
+        yield counter("pstpu:kv_handoffs_total",
+                      "Completed KV handoff transfers "
+                      "(published or consumed)",
+                      d.get("kv_handoffs_total", 0))
+        yield counter("pstpu:kv_handoff_bytes_total",
+                      "Bytes moved through the KV handoff plane",
+                      d.get("kv_handoff_bytes_total", 0))
+        yield counter("pstpu:kv_handoff_seconds_total",
+                      "Seconds spent serializing/publishing/consuming "
+                      "KV handoffs",
+                      d.get("kv_handoff_seconds_total", 0.0))
+        yield counter("pstpu:kv_handoff_failures_total",
+                      "Failed KV handoff transfers",
+                      d.get("kv_handoff_failures_total", 0))
 
 
 # vLLM's bucket boundaries for the two request-latency histograms the

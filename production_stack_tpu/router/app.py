@@ -331,6 +331,14 @@ def initialize_all(app: web.Application, args) -> None:
             DEFAULT_STORAGE_PATH,
         )
 
+        # argparse choices gate the CLI; this guards operator-rendered arg
+        # namespaces (dynamic config, tests) that bypass parse_args.
+        processor_kind = getattr(args, "batch_processor", "local")
+        if processor_kind != "local":
+            raise ValueError(
+                f"Unknown --batch-processor {processor_kind!r}; only "
+                f"'local' is implemented"
+            )
         storage_path = args.file_storage_path or DEFAULT_STORAGE_PATH
         storage = initialize_storage(args.file_storage_class, storage_path)
         app["storage"] = storage

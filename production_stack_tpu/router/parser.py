@@ -10,23 +10,34 @@ import argparse
 
 def parse_args(argv=None) -> argparse.Namespace:
     p = argparse.ArgumentParser(description="TPU production-stack router")
-    p.add_argument("--host", default="0.0.0.0")
-    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--host", default="0.0.0.0",
+                   help="bind address for the router's HTTP surface")
+    p.add_argument("--port", type=int, default=8000,
+                   help="router listen port")
 
     p.add_argument("--service-discovery", choices=["static", "k8s"],
-                   required=True)
+                   required=True,
+                   help="how backends are found: fixed list or live "
+                        "Kubernetes pod watch")
     p.add_argument("--static-backends", default=None,
                    help="comma-separated backend URLs (static discovery)")
     p.add_argument("--static-models", default=None,
                    help="comma-separated model names, one entry per backend")
-    p.add_argument("--k8s-namespace", default="default")
-    p.add_argument("--k8s-port", type=int, default=8000)
-    p.add_argument("--k8s-label-selector", default=None)
+    p.add_argument("--k8s-namespace", default="default",
+                   help="namespace the pod watch scans")
+    p.add_argument("--k8s-port", type=int, default=8000,
+                   help="serving port assumed on each discovered pod")
+    p.add_argument("--k8s-label-selector", default=None,
+                   help="labelSelector limiting which pods are engines")
 
     p.add_argument("--routing-logic", default="roundrobin",
                    choices=["roundrobin", "session",
-                            "cache_aware_load_balancing", "disagg"])
-    p.add_argument("--session-key", default=None)
+                            "cache_aware_load_balancing", "disagg"],
+                   help="backend selection policy (disagg enables the "
+                        "two-hop prefill/decode flow, docs/DISAGG.md)")
+    p.add_argument("--session-key", default=None,
+                   help="request header whose value pins a session to a "
+                        "backend (session/cache-aware routing)")
     p.add_argument("--block-reuse-timeout", type=float, default=300.0,
                    help="cache-aware/disagg routers: seconds a session's KV "
                         "blocks are assumed to stay resident")
@@ -39,12 +50,18 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "disagg prefill->decode handoff rides; required and "
                         "probed for reachability with --routing-logic disagg")
 
-    p.add_argument("--engine-stats-interval", type=float, default=10.0)
-    p.add_argument("--request-stats-window", type=float, default=60.0)
-    p.add_argument("--log-stats", action="store_true")
-    p.add_argument("--log-stats-interval", type=float, default=10.0)
+    p.add_argument("--engine-stats-interval", type=float, default=10.0,
+                   help="seconds between engine /metrics scrape passes")
+    p.add_argument("--request-stats-window", type=float, default=60.0,
+                   help="sliding window for router-side request stats, "
+                        "seconds")
+    p.add_argument("--log-stats", action="store_true",
+                   help="periodically log a human-readable stats dump")
+    p.add_argument("--log-stats-interval", type=float, default=10.0,
+                   help="seconds between --log-stats dumps")
 
-    p.add_argument("--dynamic-config-json", default=None)
+    p.add_argument("--dynamic-config-json", default=None,
+                   help="path to a hot-reloaded dynamic config JSON file")
     p.add_argument("--feature-gates", default="",
                    help="comma-separated Name=true|false gates")
     p.add_argument("--pii-action", choices=["block", "redact"],
@@ -58,12 +75,19 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "'sentence-transformers[:model-name]' "
                         "(SemanticCache gate)")
 
-    p.add_argument("--enable-batch-api", action="store_true")
-    p.add_argument("--file-storage-class", default="local_file")
-    p.add_argument("--file-storage-path", default=None)
-    p.add_argument("--batch-processor", default="local")
+    p.add_argument("--enable-batch-api", action="store_true",
+                   help="serve the OpenAI files + batches APIs")
+    p.add_argument("--file-storage-class", default="local_file",
+                   help="files-API storage backend ('local_file')")
+    p.add_argument("--file-storage-path", default=None,
+                   help="directory for files-API content and the batch DB")
+    p.add_argument("--batch-processor", default="local",
+                   choices=["local"],
+                   help="batch execution backend ('local': in-process, "
+                        "proxied through the routing logic)")
 
-    p.add_argument("--request-rewriter", default="noop")
+    p.add_argument("--request-rewriter", default="noop",
+                   help="request rewriter implementation ('noop')")
     p.add_argument("--callbacks", default="",
                    help="dotted path to a callbacks instance")
 

@@ -73,6 +73,9 @@ class RoutedRequest:
     def __init__(self, headers, json_body):
         self.headers = headers
         self.json_body = json_body
+        # Set by the disagg flow ("prefill" / "decode") so the DisaggRouter
+        # can tell the two hops apart; None on the unified path.
+        self.disagg_hop: Optional[str] = None
 
 
 def _error(status: int, message: str, etype: str = "invalid_request_error",

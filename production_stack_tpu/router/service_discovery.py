@@ -228,7 +228,9 @@ class K8sPodIPServiceDiscovery(ServiceDiscovery):
             ) as resp:
                 data = await resp.json()
                 return [m["id"] for m in data.get("data", [])]
-        except Exception:  # noqa: BLE001 — pod may not be serving yet
+        except Exception as e:  # noqa: BLE001 — pod may not be serving yet
+            logger.debug("Model probe of %s failed (pod may not be "
+                         "serving yet): %s", url, e)
             return []
 
     async def _on_pod_event(self, session, etype: str, pod: dict) -> None:

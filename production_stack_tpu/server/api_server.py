@@ -805,8 +805,9 @@ class APIServer:
                         f"Prompt of {n_prompt} tokens exceeds max_model_len "
                         f"{self.engine.config.max_model_len}",
                     )
-        except Exception:  # noqa: BLE001 — engine will re-raise if real
-            pass
+        except Exception as e:  # noqa: BLE001 — engine will re-raise if real
+            logger.debug("Prompt-length precheck skipped (%s); the engine "
+                         "re-raises real tokenizer failures", e)
 
         lora = self._lora_name(body)
 
@@ -1107,30 +1108,52 @@ def build_engine_from_args(args: argparse.Namespace) -> ServingEngine:
 
 def parse_args(argv=None) -> argparse.Namespace:
     p = argparse.ArgumentParser(description="TPU serving engine (OpenAI API)")
-    p.add_argument("--host", default="0.0.0.0")
-    p.add_argument("--port", type=int, default=8000)
-    p.add_argument("--model", required=True)
-    p.add_argument("--served-model-name", default=None)
-    p.add_argument("--dtype", default="bfloat16")
-    p.add_argument("--max-model-len", type=int, default=2048)
-    p.add_argument("--block-size", type=int, default=16)
-    p.add_argument("--num-kv-blocks", type=int, default=None)
+    p.add_argument("--host", default="0.0.0.0",
+                   help="bind address for the engine's HTTP surface")
+    p.add_argument("--port", type=int, default=8000,
+                   help="engine listen port")
+    p.add_argument("--model", required=True,
+                   help="model name or HF checkpoint path to serve")
+    p.add_argument("--served-model-name", default=None,
+                   help="name advertised on /v1/models (default: --model)")
+    p.add_argument("--dtype", default="bfloat16",
+                   help="compute/KV dtype (bfloat16 | float32)")
+    p.add_argument("--max-model-len", type=int, default=2048,
+                   help="max prompt+generation length in tokens")
+    p.add_argument("--block-size", type=int, default=16,
+                   help="KV cache block size in tokens")
+    p.add_argument("--num-kv-blocks", type=int, default=None,
+                   help="KV pool size in blocks (default: sized from "
+                        "--gpu-memory-utilization)")
     # flag name kept vllm-compatible (reference chart renders it):
-    p.add_argument("--gpu-memory-utilization", type=float, default=0.9)
-    p.add_argument("--no-enable-prefix-caching", action="store_true")
-    p.add_argument("--max-num-seqs", type=int, default=64)
+    p.add_argument("--gpu-memory-utilization", type=float, default=0.9,
+                   help="fraction of device memory (TPU HBM) for the KV "
+                        "pool (vLLM-compatible flag name)")
+    p.add_argument("--no-enable-prefix-caching", action="store_true",
+                   help="disable hash-chained prefix caching")
+    p.add_argument("--max-num-seqs", type=int, default=64,
+                   help="max sequences resident in the batch")
     # None -> inherit the EngineConfig dataclass default (the tuned value);
     # an explicit flag always wins (the Helm chart renders these).
-    p.add_argument("--max-num-batched-tokens", type=int, default=None)
-    p.add_argument("--tensor-parallel-size", type=int, default=1)
-    p.add_argument("--sequence-parallel-size", type=int, default=1)
-    p.add_argument("--data-parallel-size", type=int, default=1)
-    p.add_argument("--num-decode-steps", type=int, default=None)
+    p.add_argument("--max-num-batched-tokens", type=int, default=None,
+                   help="prefill chunk token budget (default: EngineConfig "
+                        "tuned value)")
+    p.add_argument("--tensor-parallel-size", type=int, default=1,
+                   help="tp degree across the slice mesh")
+    p.add_argument("--sequence-parallel-size", type=int, default=1,
+                   help="sp degree (ring-attention prefill)")
+    p.add_argument("--data-parallel-size", type=int, default=1,
+                   help="dp replica count within this process")
+    p.add_argument("--num-decode-steps", type=int, default=None,
+                   help="fused decode scan length K (default: EngineConfig "
+                        "tuned value)")
     p.add_argument("--decode-loop", default=None, choices=["while", "scan"],
                    help="fused-decode loop construct A/B "
                         "(EngineConfig.decode_loop)")
     p.add_argument("--attn-impl", default="auto",
-                   choices=["auto", "window", "paged", "xla", "pallas"])
+                   choices=["auto", "window", "paged", "xla", "pallas"],
+                   help="decode attention path (auto picks Pallas paged "
+                        "vs gathered window by worst-case window size)")
     p.add_argument("--no-warmup", action="store_true",
                    help="Skip AOT warmup compilation at startup")
     p.add_argument("--no-overlap-dispatch", action="store_true",

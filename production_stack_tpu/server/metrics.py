@@ -21,7 +21,8 @@ def render_engine_metrics(engine: "ServingEngine", model_name: str) -> str:
     for key in ("decode_dispatches_total", "prefill_dispatches_total",
                 "dispatch_overlap_ratio", "dispatch_gap_seconds_total",
                 "kv_handoffs_total", "kv_handoff_bytes_total",
-                "kv_handoff_seconds_total", "kv_handoff_failures_total"):
+                "kv_handoff_seconds_total", "kv_handoff_failures_total",
+                "engine_uptime_seconds", "kv_offload_blocks"):
         s.setdefault(key, 0)
     s.setdefault("disagg_role", "unified")
     label = f'{{model_name="{model_name}"}}'
@@ -50,6 +51,16 @@ def render_engine_metrics(engine: "ServingEngine", model_name: str) -> str:
         "# HELP vllm:generation_tokens_total Generated tokens",
         "# TYPE vllm:generation_tokens_total counter",
         f"vllm:generation_tokens_total{label} {s['generation_tokens_total']}",
+        # Same series the prometheus_client collector (engine/metrics.py)
+        # exports — the two renderers must not drift (pstpu-lint PL004).
+        "# HELP pstpu:engine_uptime_seconds Engine uptime",
+        "# TYPE pstpu:engine_uptime_seconds gauge",
+        f"pstpu:engine_uptime_seconds{label} "
+        f"{s['engine_uptime_seconds']:.6f}",
+        "# HELP pstpu:kv_offload_blocks KV blocks resident in the host "
+        "offload pool",
+        "# TYPE pstpu:kv_offload_blocks gauge",
+        f"pstpu:kv_offload_blocks{label} {s['kv_offload_blocks']}",
         # Two-slot dispatch-pipeline telemetry (engine.py:_run_loop): the
         # prefill/decode overlap win is observable, not asserted.
         "# HELP pstpu:decode_dispatches_total Fused decode dispatches issued",

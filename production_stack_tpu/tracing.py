@@ -189,6 +189,7 @@ class Tracer:
         if batch:
             try:
                 self._post(batch)
+            # pstpu-lint: allow[PL003] reason=best-effort span flush at interpreter shutdown; logging may already be torn down
             except Exception:  # noqa: BLE001
                 pass
 

@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# One-command static analysis gate: ruff (error-tier) + scoped mypy +
+# pstpu-lint. CI runs this in the `lint` job; locally it degrades
+# gracefully — ruff/mypy are optional extras (pip install -e .[lint]) and
+# are skipped with a warning when absent, while the stdlib-only pstpu-lint
+# pass always runs. Pass --require-tools (CI does) to make a missing
+# ruff/mypy a failure instead of a skip.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+REQUIRE_TOOLS=0
+[ "${1:-}" = "--require-tools" ] && REQUIRE_TOOLS=1
+
+# GitHub annotations render findings inline on the PR diff.
+FORMAT=text
+[ "${GITHUB_ACTIONS:-}" = "true" ] && FORMAT=github
+
+fail=0
+
+if python -m ruff --version >/dev/null 2>&1; then
+    echo "== ruff (error-tier rules; [tool.ruff.lint] in pyproject.toml)"
+    python -m ruff check production_stack_tpu tools benchmarks || fail=1
+else
+    echo "== ruff not installed — skipping (pip install -e .[lint])"
+    [ "$REQUIRE_TOOLS" = 1 ] && fail=1
+fi
+
+if python -m mypy --version >/dev/null 2>&1; then
+    # Scope: the router + disagg tiers (the asyncio data plane, where type
+    # confusion turns into 3am pages). Widen as annotations land; config
+    # and per-flag rationale live under [tool.mypy] in pyproject.toml.
+    echo "== mypy (scoped: router/ + disagg/)"
+    python -m mypy production_stack_tpu/router production_stack_tpu/disagg \
+        || fail=1
+else
+    echo "== mypy not installed — skipping (pip install -e .[lint])"
+    [ "$REQUIRE_TOOLS" = 1 ] && fail=1
+fi
+
+echo "== pstpu-lint (tools/pstpu_lint; docs/LINTING.md has the catalogue)"
+python -m tools.pstpu_lint production_stack_tpu/ tools/ benchmarks/ \
+    --format "$FORMAT" || fail=1
+
+exit $fail

@@ -1,0 +1,657 @@
+"""pstpu-lint rule suite: every rule code with a firing and a non-firing
+fixture, the waiver machinery, and the live-repo-lints-clean gate.
+
+The fixtures build miniature project trees (the per-file rules scope by
+project-relative path, so files land under production_stack_tpu/...) and
+run through the real driver; the project-level rules (PL004/PL006) are
+exercised through their check functions with synthetic sources. The final
+test lints the actual repository — a regression that introduces a finding
+fails tier-1 here, not just the CI lint job.
+"""
+
+import os
+import sys
+import textwrap
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.pstpu_lint import run_lint  # noqa: E402
+from tools.pstpu_lint.core import Finding, main, parse_waivers  # noqa: E402
+
+
+def _write(tmp_path, relpath, source):
+    path = tmp_path / relpath
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return path
+
+
+def _lint(tmp_path, *relpaths):
+    return run_lint(
+        [str(tmp_path / r) for r in relpaths],
+        project_root=str(tmp_path), project_rules=False,
+    )
+
+
+def _codes(findings):
+    return [f.rule for f in findings]
+
+
+ROUTER_FILE = "production_stack_tpu/router/mod.py"
+
+
+# ---------------------------------------------------------------------- PL001
+class TestBlockedEventLoop:
+    def test_fires_on_sleep_in_async_def(self, tmp_path):
+        _write(tmp_path, ROUTER_FILE, """
+            import time
+
+            async def handler(request):
+                time.sleep(0.5)
+        """)
+        findings = _lint(tmp_path, ROUTER_FILE)
+        assert _codes(findings) == ["PL001"]
+        assert "time.sleep" in findings[0].message
+
+    def test_fires_through_sync_helper_call_chain(self, tmp_path):
+        _write(tmp_path, ROUTER_FILE, """
+            import requests
+
+            async def handler(request):
+                return _fetch()
+
+            def _fetch():
+                return requests.get("http://backend/metrics")
+        """)
+        findings = _lint(tmp_path, ROUTER_FILE)
+        assert _codes(findings) == ["PL001"]
+        assert "reachable from async def handler" in findings[0].message
+
+    def test_thread_target_is_exempt(self, tmp_path):
+        # The stats-scraper shape: a daemon-thread worker loop may sleep
+        # and use requests; nothing async calls it, so no finding.
+        _write(tmp_path, ROUTER_FILE, """
+            import threading
+            import time
+            import requests
+
+            class Scraper:
+                def start(self):
+                    self._thread = threading.Thread(
+                        target=self._worker, daemon=True
+                    )
+                    self._thread.start()
+
+                def _worker(self):
+                    while True:
+                        requests.get("http://engine/metrics")
+                        time.sleep(10)
+        """)
+        assert _lint(tmp_path, ROUTER_FILE) == []
+
+    def test_executor_target_is_exempt(self, tmp_path):
+        # The files-service shape: blocking I/O in a nested def handed to
+        # run_in_executor runs off-loop — a reference, not a call.
+        _write(tmp_path, ROUTER_FILE, """
+            import asyncio
+
+            async def save(content):
+                def _write():
+                    with open("/tmp/x", "wb") as f:
+                        f.write(content)
+
+                loop = asyncio.get_running_loop()
+                await loop.run_in_executor(None, _write)
+        """)
+        assert _lint(tmp_path, ROUTER_FILE) == []
+
+    def test_out_of_scope_package_not_checked(self, tmp_path):
+        # PL001 scopes to the data-plane packages; the engine tier runs
+        # its blocking work on executors by design.
+        rel = "production_stack_tpu/engine/mod.py"
+        _write(tmp_path, rel, """
+            import time
+
+            async def loop_step():
+                time.sleep(1)
+        """)
+        assert "PL001" not in _codes(_lint(tmp_path, rel))
+
+
+# ---------------------------------------------------------------------- PL002
+class TestFireAndForget:
+    def test_fires_on_dropped_create_task(self, tmp_path):
+        _write(tmp_path, ROUTER_FILE, """
+            import asyncio
+
+            async def go(coro):
+                asyncio.create_task(coro)
+        """)
+        findings = _lint(tmp_path, ROUTER_FILE)
+        assert _codes(findings) == ["PL002"]
+
+    def test_fires_on_underscore_ensure_future(self, tmp_path):
+        _write(tmp_path, ROUTER_FILE, """
+            import asyncio
+
+            async def go(coro):
+                _ = asyncio.ensure_future(coro)
+        """)
+        assert _codes(_lint(tmp_path, ROUTER_FILE)) == ["PL002"]
+
+    def test_non_asyncio_receivers_are_clean(self, tmp_path):
+        # A domain method named create_task is not an asyncio spawn, and
+        # TaskGroup.create_task holds a strong ref + propagates exceptions.
+        _write(tmp_path, ROUTER_FILE, """
+            import asyncio
+
+            async def a(self):
+                self.scheduler.create_task("prefill")
+
+            async def b():
+                async with asyncio.TaskGroup() as tg:
+                    tg.create_task(work())
+        """)
+        assert _lint(tmp_path, ROUTER_FILE) == []
+
+    def test_loop_receiver_fires(self, tmp_path):
+        _write(tmp_path, ROUTER_FILE, """
+            import asyncio
+
+            async def go(coro):
+                asyncio.get_event_loop().create_task(coro)
+        """)
+        assert _codes(_lint(tmp_path, ROUTER_FILE)) == ["PL002"]
+
+    def test_stored_handle_is_clean(self, tmp_path):
+        _write(tmp_path, ROUTER_FILE, """
+            import asyncio
+
+            class Engine:
+                async def start(self, coro, other):
+                    self._task = asyncio.create_task(coro)
+                    t = asyncio.ensure_future(other)
+                    self._tasks.add(t)
+                    t.add_done_callback(self._tasks.discard)
+        """)
+        assert _lint(tmp_path, ROUTER_FILE) == []
+
+
+# ---------------------------------------------------------------------- PL003
+class TestSwallowedExceptions:
+    def test_fires_on_silent_catch_all(self, tmp_path):
+        _write(tmp_path, ROUTER_FILE, """
+            def probe(url):
+                try:
+                    return fetch(url)
+                except Exception:
+                    return []
+        """)
+        assert _codes(_lint(tmp_path, ROUTER_FILE)) == ["PL003"]
+
+    def test_fires_on_bare_except_pass(self, tmp_path):
+        _write(tmp_path, ROUTER_FILE, """
+            def close(sock):
+                try:
+                    sock.close()
+                except:
+                    pass
+        """)
+        assert _codes(_lint(tmp_path, ROUTER_FILE)) == ["PL003"]
+
+    def test_logged_metric_or_used_exception_is_clean(self, tmp_path):
+        _write(tmp_path, ROUTER_FILE, """
+            def a(logger):
+                try:
+                    work()
+                except Exception:
+                    logger.exception("work failed")
+
+            def b(self):
+                try:
+                    work()
+                except Exception:
+                    self.failures_total += 1
+
+            def c(metrics):
+                try:
+                    work()
+                except Exception:
+                    metrics.errors.labels(kind="x").inc()
+
+            def d():
+                try:
+                    work()
+                except Exception as e:
+                    return error_response(400, f"failed: {e}")
+
+            def e_():
+                try:
+                    work()
+                except ValueError:
+                    return None   # narrow except: not a catch-all
+
+            def f(metrics, url):
+                try:
+                    work()
+                except Exception:
+                    # .set() on a metric receiver (labels chain) counts
+                    metrics.circuit_state.labels(server=url).set(1)
+        """)
+        assert _lint(tmp_path, ROUTER_FILE) == []
+
+    def test_event_set_is_not_metric_evidence(self, tmp_path):
+        # Event.set() is a shutdown signal, not failure evidence — the
+        # exception is still swallowed silently.
+        _write(tmp_path, ROUTER_FILE, """
+            def worker(self):
+                try:
+                    work()
+                except Exception:
+                    self._shutdown.set()
+        """)
+        assert _codes(_lint(tmp_path, ROUTER_FILE)) == ["PL003"]
+
+
+# ---------------------------------------------------------------------- PL004
+class TestMetricsDrift:
+    REG = None   # built lazily so import stays at module level
+
+    @staticmethod
+    def _registry():
+        from tools.pstpu_lint.metrics_registry import (
+            ENGINE_COLLECTOR,
+            ENGINE_TEXT,
+            ROUTER,
+            Series,
+        )
+
+        return (
+            Series("pstpu:good_total", "counter", ("model_name",),
+                   (ENGINE_TEXT, ENGINE_COLLECTOR), ("catalogue",), "doc"),
+            Series("router_good_total", "counter", (), (ROUTER,),
+                   ("catalogue",), "doc", router_labels=("server",)),
+        )
+
+    def _tree(self, tmp_path, server_body=None):
+        _write(tmp_path, "production_stack_tpu/server/metrics.py",
+               server_body or '''
+            def render(s, label):
+                return [
+                    "# TYPE pstpu:good_total counter",
+                    f"pstpu:good_total{label} {s['good']}",
+                ]
+        ''')
+        _write(tmp_path, "production_stack_tpu/engine/metrics.py", """
+            labels = ["model_name"]
+
+            def collect(counter, eng):
+                yield counter("pstpu:good_total", "doc", eng.good)
+        """)
+        _write(tmp_path, "production_stack_tpu/router/metrics.py", """
+            from prometheus_client import Counter
+
+            good = Counter("router_good", "doc", ["server"])
+        """)
+
+    def test_clean_tree_passes(self, tmp_path):
+        from tools.pstpu_lint.rules.metrics_drift import check_metrics
+
+        self._tree(tmp_path)
+        assert check_metrics(str(tmp_path), registry=self._registry(),
+                             docs_check=False) == []
+
+    def test_label_set_mismatch_between_renderers_fires(self, tmp_path):
+        # The text renderer grows a 'role' label the collector (and the
+        # registry) do not have — the parallel renderers drifted.
+        from tools.pstpu_lint.rules.metrics_drift import check_metrics
+
+        self._tree(tmp_path, server_body='''
+            def render(s, model_name):
+                return [
+                    "# TYPE pstpu:good_total counter",
+                    f'pstpu:good_total{{model_name="{model_name}",'
+                    f'role="{s["role"]}"}} 1',
+                ]
+        ''')
+        findings = check_metrics(str(tmp_path), registry=self._registry(),
+                                 docs_check=False)
+        assert [f.rule for f in findings] == ["PL004"]
+        assert "label set" in findings[0].message
+
+    def test_unregistered_series_fires(self, tmp_path):
+        from tools.pstpu_lint.rules.metrics_drift import check_metrics
+
+        self._tree(tmp_path, server_body='''
+            def render(s, label):
+                return [
+                    "# TYPE pstpu:good_total counter",
+                    f"pstpu:good_total{label} 1",
+                    "# TYPE pstpu:sneaky_total counter",
+                    f"pstpu:sneaky_total{label} 1",
+                ]
+        ''')
+        findings = check_metrics(str(tmp_path), registry=self._registry(),
+                                 docs_check=False)
+        assert any("not in the metrics registry" in f.message
+                   for f in findings)
+
+    def test_bad_prefix_and_duplicate_fire(self, tmp_path):
+        from tools.pstpu_lint.rules.metrics_drift import check_metrics
+
+        self._tree(tmp_path, server_body='''
+            def render(s, label):
+                return [
+                    "# TYPE pstpu:good_total counter",
+                    f"pstpu:good_total{label} 1",
+                    "# TYPE pstpu:good_total counter",
+                    "# TYPE my_rogue_series gauge",
+                ]
+        ''')
+        findings = check_metrics(str(tmp_path), registry=self._registry(),
+                                 docs_check=False)
+        msgs = " | ".join(f.message for f in findings)
+        assert "more than once" in msgs
+        assert "naming convention" in msgs
+
+    def test_missing_from_one_renderer_fires(self, tmp_path):
+        # Registered for both engine surfaces but the collector dropped it.
+        from tools.pstpu_lint.rules.metrics_drift import check_metrics
+
+        self._tree(tmp_path)
+        _write(tmp_path, "production_stack_tpu/engine/metrics.py", """
+            labels = ["model_name"]
+
+            def collect(counter, eng):
+                yield counter("pstpu:other_total", "doc", 0)
+        """)
+        findings = check_metrics(str(tmp_path), registry=self._registry(),
+                                 docs_check=False)
+        assert any("does not emit it" in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------- PL005
+class TestAwaitUnderLock:
+    def test_fires_on_await_inside_with_lock(self, tmp_path):
+        rel = "production_stack_tpu/engine/mod.py"
+        _write(tmp_path, rel, """
+            async def apply(self, batch):
+                with self._lock:
+                    await self.runner.dispatch(batch)
+        """)
+        findings = _lint(tmp_path, rel)
+        assert _codes(findings) == ["PL005"]
+        assert "_lock" in findings[0].message
+
+    def test_waiver_at_lock_acquisition_site_suppresses(self, tmp_path):
+        # Findings anchor to the `with` line, so the natural waiver
+        # placement (at the acquisition the message names) works.
+        rel = "production_stack_tpu/engine/mod.py"
+        _write(tmp_path, rel, """
+            async def apply(self, batch):
+                # pstpu-lint: allow[PL005] reason=lock is a fake in tests
+                with self._lock:
+                    await self.runner.dispatch(batch)
+        """)
+        assert _lint(tmp_path, rel) == []
+
+    def test_async_with_and_no_await_are_clean(self, tmp_path):
+        rel = "production_stack_tpu/engine/mod.py"
+        _write(tmp_path, rel, """
+            async def a(self, batch):
+                async with self._lock:
+                    await self.runner.dispatch(batch)
+
+            def b(self):
+                with self._lock:
+                    return dict(self.stats)
+
+            async def c(self, rows):
+                with self._lock:
+                    self.rows = rows
+                await self.flush()
+        """)
+        assert _lint(tmp_path, rel) == []
+
+
+# ---------------------------------------------------------------------- PL006
+class TestFlagDrift:
+    def _tree(self, tmp_path, readme_flags=("--wired",),
+              reference_dest=True):
+        _write(tmp_path, "production_stack_tpu/router/parser.py", """
+            import argparse
+
+            def parse_args():
+                p = argparse.ArgumentParser()
+                p.add_argument("--wired", default="x", help="used flag")
+                p.add_argument("--orphan", default="y", help="dead flag")
+                return p.parse_args()
+        """)
+        # The engine parser reads its own flag in its own tier (references
+        # are scoped per parser — see the collision test below).
+        _write(tmp_path, "production_stack_tpu/server/api_server.py", """
+            import argparse
+
+            def parse_args():
+                p = argparse.ArgumentParser()
+                p.add_argument("--model", required=True, help="model")
+                return p.parse_args()
+
+            def main(args):
+                print(args.model)
+        """)
+        uses = "args.wired" if reference_dest else "None"
+        _write(tmp_path, "production_stack_tpu/router/app.py", f"""
+            def main(args):
+                print({uses}, args.orphan)
+        """)
+        rows = "\n".join(f"| `{f}` | x | doc |" for f in readme_flags)
+        _write(tmp_path, "README.md", f"""
+            # readme
+
+            | Flag | Default | What it does |
+            |---|---|---|
+            {rows}
+        """)
+
+    def test_clean_tree_passes(self, tmp_path):
+        from tools.pstpu_lint.rules.flag_drift import check_flags
+
+        self._tree(tmp_path,
+                   readme_flags=("--wired", "--orphan", "--model"))
+        assert check_flags(str(tmp_path)) == []
+
+    def test_undocumented_flag_fires(self, tmp_path):
+        from tools.pstpu_lint.rules.flag_drift import check_flags
+
+        self._tree(tmp_path, readme_flags=("--wired", "--model"))
+        findings = check_flags(str(tmp_path))
+        assert ["PL006"] == [f.rule for f in findings]
+        assert "--orphan" in findings[0].message
+        assert "not documented" in findings[0].message
+
+    def test_unreferenced_flag_fires(self, tmp_path):
+        from tools.pstpu_lint.rules.flag_drift import check_flags
+
+        self._tree(tmp_path,
+                   readme_flags=("--wired", "--orphan", "--model"),
+                   reference_dest=False)
+        findings = check_flags(str(tmp_path))
+        assert [f.rule for f in findings] == ["PL006"]
+        assert "args.wired is never read" in findings[0].message
+
+    def test_cross_tier_dest_collision_not_pooled(self, tmp_path):
+        # --host exists in BOTH parsers (as in the real tree); only the
+        # engine tier reads it — the router's copy must still be flagged,
+        # not hide behind the other tier's read.
+        from tools.pstpu_lint.rules.flag_drift import check_flags
+
+        self._tree(tmp_path,
+                   readme_flags=("--wired", "--orphan", "--model",
+                                 "--host"))
+        _write(tmp_path, "production_stack_tpu/router/parser.py", """
+            import argparse
+
+            def parse_args():
+                p = argparse.ArgumentParser()
+                p.add_argument("--wired", default="x", help="used flag")
+                p.add_argument("--orphan", default="y", help="dead flag")
+                p.add_argument("--host", default="0.0.0.0", help="bind")
+                return p.parse_args()
+        """)
+        _write(tmp_path, "production_stack_tpu/server/api_server.py", """
+            import argparse
+
+            def parse_args():
+                p = argparse.ArgumentParser()
+                p.add_argument("--model", required=True, help="model")
+                p.add_argument("--host", default="0.0.0.0", help="bind")
+                return p.parse_args()
+
+            def main(args):
+                print(args.model, args.host)
+        """)
+        findings = check_flags(str(tmp_path))
+        assert ["PL006"] == [f.rule for f in findings]
+        assert "--host" in findings[0].message
+        assert findings[0].file.endswith("router/parser.py")
+
+
+# -------------------------------------------------------------------- waivers
+class TestWaivers:
+    def test_waiver_with_reason_suppresses(self, tmp_path):
+        _write(tmp_path, ROUTER_FILE, """
+            import time
+
+            async def handler(request):
+                time.sleep(0.01)  # pstpu-lint: allow[PL001] reason=test probe
+        """)
+        assert _lint(tmp_path, ROUTER_FILE) == []
+
+    def test_trailing_waiver_on_wrapped_statement_suppresses(self, tmp_path):
+        # The finding anchors at the call's first line; a comment trailing
+        # the closing-paren line must anchor to the logical-line START.
+        _write(tmp_path, ROUTER_FILE, """
+            import time
+
+            async def handler(request):
+                time.sleep(
+                    0.01
+                )  # pstpu-lint: allow[PL001] reason=test probe
+        """)
+        assert _lint(tmp_path, ROUTER_FILE) == []
+
+    def test_standalone_waiver_line_anchors_to_next_code_line(self, tmp_path):
+        _write(tmp_path, ROUTER_FILE, """
+            import time
+
+            async def handler(request):
+                # pstpu-lint: allow[PL001] reason=test probe
+                time.sleep(0.01)
+        """)
+        assert _lint(tmp_path, ROUTER_FILE) == []
+
+    def test_reasonless_waiver_is_pl000(self, tmp_path):
+        _write(tmp_path, ROUTER_FILE, """
+            import time
+
+            async def handler(request):
+                time.sleep(0.01)  # pstpu-lint: allow[PL001]
+        """)
+        findings = _lint(tmp_path, ROUTER_FILE)
+        # The finding is suppressed, but the reason-less waiver is an error.
+        assert _codes(findings) == ["PL000"]
+        assert "no reason" in findings[0].message
+
+    def test_stale_waiver_is_pl000(self, tmp_path):
+        _write(tmp_path, ROUTER_FILE, """
+            async def handler(request):
+                return 1  # pstpu-lint: allow[PL001] reason=left over
+        """)
+        findings = _lint(tmp_path, ROUTER_FILE)
+        assert _codes(findings) == ["PL000"]
+        assert "suppresses nothing" in findings[0].message
+
+    def test_parse_waivers_multi_rule(self):
+        src = "x = 1  # pstpu-lint: allow[PL001,PL003] reason=why not\n"
+        (w,) = parse_waivers("f.py", src)
+        assert w.rules == ("PL001", "PL003")
+        assert w.reason == "why not"
+        assert w.anchor_line == 1
+
+
+# ------------------------------------------------------------------ reporting
+class TestReporting:
+    def test_github_annotation_format(self):
+        f = Finding("PL001", "production_stack_tpu/router/app.py", 12,
+                    "time.sleep() blocks the event loop")
+        out = f.render("github")
+        assert out.startswith(
+            "::error file=production_stack_tpu/router/app.py,line=12,"
+        )
+        assert "PL001" in out and "time.sleep" in out
+
+    def test_malformed_file_is_a_finding_not_a_crash(self, tmp_path):
+        # IndentationError escapes tokenize; the run must survive with a
+        # PL000 finding, not abort and lose every other file's findings.
+        _write(tmp_path, ROUTER_FILE,
+               "def f():\n        x = 1\n    y = 2\n")
+        findings = _lint(tmp_path, ROUTER_FILE)
+        assert _codes(findings) == ["PL000"]
+        assert "does not parse" in findings[0].message
+
+    def test_cli_exit_codes(self, tmp_path, capsys):
+        _write(tmp_path, ROUTER_FILE, """
+            import time
+
+            async def handler(request):
+                time.sleep(0.01)
+        """)
+        rc = main([str(tmp_path / ROUTER_FILE),
+                   "--project-root", str(tmp_path),
+                   "--no-project-rules", "--format", "github"])
+        assert rc == 1
+        assert "::error file=" in capsys.readouterr().out
+
+        (tmp_path / ROUTER_FILE).write_text("x = 1\n")
+        rc = main([str(tmp_path / ROUTER_FILE),
+                   "--project-root", str(tmp_path), "--no-project-rules"])
+        assert rc == 0
+
+
+# ------------------------------------------------------------------ the gate
+class TestLiveRepo:
+    def test_repo_lints_clean(self):
+        """The acceptance gate: the real tree has zero findings (and so
+        zero reason-less or stale waivers). A new violation fails tier-1
+        here, not just the CI lint job."""
+        findings = run_lint(
+            [os.path.join(REPO, p)
+             for p in ("production_stack_tpu", "tools", "benchmarks")],
+            project_root=REPO,
+        )
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_docs_tables_are_fresh(self):
+        """docs/METRICS.md + the focused tables + README flag tables match
+        the registries (regenerate with python -m tools.pstpu_lint.gen_docs)."""
+        from tools.pstpu_lint.gen_docs import check_flag_tables, check_tables
+
+        assert check_tables(REPO) == []
+        assert check_flag_tables(REPO) == []
+
+    def test_deliberate_violation_fails(self, tmp_path):
+        """The CI acceptance probe: introducing a time.sleep in an async
+        def in the router makes the lint fail with a file/line finding."""
+        bad = _write(tmp_path, ROUTER_FILE, """
+            import time
+
+            async def handle_completions(request):
+                time.sleep(1)
+        """)
+        findings = run_lint([str(bad)], project_root=str(tmp_path),
+                            project_rules=False)
+        assert [f.rule for f in findings] == ["PL001"]
+        assert findings[0].line == 5

@@ -1,0 +1,26 @@
+"""pstpu-lint: in-repo static analysis for the serving stack.
+
+An AST-based rule suite (stdlib ``ast``/``tokenize`` only, no runtime deps)
+tuned to this codebase's real failure modes: a thin asyncio router fronting
+many engines lives or dies on "never block the event loop, never leak a
+task, never let a metric silently drift". Each rule has a stable code; see
+docs/LINTING.md for the catalogue with before/after examples.
+
+  PL001  blocked-event-loop       sync I/O reachable inside async defs
+  PL002  fire-and-forget-task     dropped asyncio.create_task handles
+  PL003  swallowed-exception      silent catch-alls in the data plane
+  PL004  metrics-drift            renderer/registry/docs series consistency
+  PL005  await-under-lock         await while holding a threading lock
+  PL006  config-flag-drift        argparse flags unreferenced/undocumented
+  PL000  waiver-hygiene           reason-less or stale lint waivers
+
+Findings are suppressed per line with a linted waiver comment::
+
+    time.sleep(0.1)  # pstpu-lint: allow[PL001] reason=startup-only probe
+
+Usage: ``python -m tools.pstpu_lint [paths] [--format text|github]``.
+"""
+
+from tools.pstpu_lint.core import Finding, main, run_lint  # noqa: F401
+
+__all__ = ["Finding", "main", "run_lint"]

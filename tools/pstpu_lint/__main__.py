@@ -1,0 +1,8 @@
+"""CLI entry point: ``python -m tools.pstpu_lint [paths]``."""
+
+import sys
+
+from tools.pstpu_lint.core import main
+
+if __name__ == "__main__":
+    sys.exit(main())

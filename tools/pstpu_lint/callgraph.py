@@ -1,0 +1,159 @@
+"""Module-local call graph: which function bodies run on the event loop.
+
+PL001 must flag ``time.sleep`` in a helper a request handler calls, but NOT
+in a thread worker (the stats scraper loop, the K8s watch loop) or in a
+callable handed to ``run_in_executor``/``threading.Thread`` — those run off
+the loop by construction. The distinction is call-graph *context*, not
+file-level waivers:
+
+  * seeds: every ``async def`` body;
+  * edges: plain same-module calls — bare names resolved against enclosing
+    function scopes then module level, ``self.method()`` resolved against
+    the enclosing class;
+  * non-edges: passing a function as a value (``Thread(target=f)``,
+    ``loop.run_in_executor(None, f)``, ``task.add_done_callback(f)``) is a
+    reference, not a call, so thread/executor targets are never pulled into
+    the async context unless something async also calls them directly.
+
+Cross-module calls are not resolved (documented limitation — the suite is
+per-module by design; the repo's blocking helpers and their async callers
+live in the same module).
+"""
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass
+class FunctionInfo:
+    qualname: str
+    node: ast.AST                      # FunctionDef | AsyncFunctionDef
+    is_async: bool
+    enclosing_class: Optional[str]     # qualname of the owning class
+    parent_function: Optional[str]     # qualname of the enclosing function
+    calls: List[Tuple[str, int]] = field(default_factory=list)
+    # (callee qualname, call line) — resolved, module-local
+
+
+def _own_statements(node: ast.AST):
+    """Walk a function body WITHOUT descending into nested function/class
+    definitions (their bodies are separate call-graph nodes)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+            continue
+        yield child
+        stack.extend(ast.iter_child_nodes(child))
+
+
+class _Collector(ast.NodeVisitor):
+    def __init__(self):
+        self.functions: Dict[str, FunctionInfo] = {}
+        self._scope: List[Tuple[str, str]] = []   # (kind, name) kind∈{c,f}
+
+    def _qual(self, name: str) -> str:
+        return ".".join([n for _, n in self._scope] + [name])
+
+    def _visit_func(self, node, is_async: bool):
+        qual = self._qual(node.name)
+        encl_class = None
+        parent_fn = None
+        # Innermost enclosing class (``self`` in a closure still refers to
+        # that class's instance) ...
+        for i in range(len(self._scope) - 1, -1, -1):
+            if self._scope[i][0] == "c":
+                encl_class = ".".join(n for _, n in self._scope[:i + 1])
+                break
+        # ... and innermost enclosing function, but not across a class
+        # boundary (a method is not "nested in" the function defining its
+        # class for name-resolution purposes).
+        for i in range(len(self._scope) - 1, -1, -1):
+            if self._scope[i][0] == "f":
+                parent_fn = ".".join(n for _, n in self._scope[:i + 1])
+                break
+            if self._scope[i][0] == "c":
+                break
+        self.functions[qual] = FunctionInfo(
+            qualname=qual, node=node, is_async=is_async,
+            enclosing_class=encl_class, parent_function=parent_fn,
+        )
+        self._scope.append(("f", node.name))
+        self.generic_visit(node)
+        self._scope.pop()
+
+    def visit_FunctionDef(self, node):
+        self._visit_func(node, is_async=False)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._visit_func(node, is_async=True)
+
+    def visit_ClassDef(self, node):
+        self._scope.append(("c", node.name))
+        self.generic_visit(node)
+        self._scope.pop()
+
+
+class CallGraph:
+    def __init__(self, tree: ast.AST):
+        collector = _Collector()
+        collector.visit(tree)
+        self.functions = collector.functions
+        self._resolve_calls()
+
+    # ------------------------------------------------------------ resolution
+    def _resolve_name(self, caller: FunctionInfo, name: str) -> Optional[str]:
+        """A bare-name call: nested defs of enclosing functions first
+        (innermost out), then module level."""
+        fn: Optional[FunctionInfo] = caller
+        while fn is not None:
+            nested = f"{fn.qualname}.{name}"
+            if nested in self.functions:
+                return nested
+            fn = self.functions.get(fn.parent_function) \
+                if fn.parent_function else None
+        return name if name in self.functions else None
+
+    def _resolve_self_method(self, caller: FunctionInfo,
+                             method: str) -> Optional[str]:
+        if caller.enclosing_class is None:
+            return None
+        qual = f"{caller.enclosing_class}.{method}"
+        return qual if qual in self.functions else None
+
+    def _resolve_calls(self) -> None:
+        for info in self.functions.values():
+            for node in _own_statements(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = None
+                if isinstance(node.func, ast.Name):
+                    target = self._resolve_name(info, node.func.id)
+                elif (isinstance(node.func, ast.Attribute)
+                      and isinstance(node.func.value, ast.Name)
+                      and node.func.value.id in ("self", "cls")):
+                    target = self._resolve_self_method(info, node.func.attr)
+                if target is not None:
+                    info.calls.append((target, node.lineno))
+
+    # ------------------------------------------------------------- traversal
+    def async_context(self) -> Dict[str, List[str]]:
+        """qualname -> chain of callers from an async seed (the seed itself
+        maps to a one-element chain). Sync functions only reachable as
+        thread/executor targets never appear here."""
+        chains: Dict[str, List[str]] = {}
+        frontier = []
+        for qual, info in self.functions.items():
+            if info.is_async:
+                chains[qual] = [qual]
+                frontier.append(qual)
+        while frontier:
+            qual = frontier.pop()
+            for callee, _line in self.functions[qual].calls:
+                if callee in chains:
+                    continue
+                chains[callee] = chains[qual] + [callee]
+                frontier.append(callee)
+        return chains

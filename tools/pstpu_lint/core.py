@@ -1,0 +1,281 @@
+"""Driver: file collection, waiver parsing, rule dispatch, reporting.
+
+Two rule shapes (tools/pstpu_lint/rules/__init__.py registers both):
+
+  * per-file rules — ``fn(relpath, tree, source) -> [Finding]`` run on every
+    collected ``.py`` file whose project-relative path matches the rule's
+    scope prefixes (scope ``None`` = every file);
+  * project rules — ``fn(project_root) -> [Finding]`` run once per
+    invocation when their anchor files exist under the project root (the
+    metrics-consistency and flag-drift passes need the real tree shape).
+
+Waivers are comments of the form::
+
+    # pstpu-lint: allow[PL001] reason=one-line justification
+    # pstpu-lint: allow[PL001,PL003] reason=shared justification
+
+placed on the offending line or alone on the line directly above it. The
+waivers themselves are linted (PL000): a waiver with no reason, or one that
+no longer suppresses anything, is an error — suppressions never outlive the
+finding they justified.
+"""
+
+import argparse
+import ast
+import io
+import os
+import re
+import sys
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+WAIVER_RE = re.compile(
+    r"#\s*pstpu-lint:\s*allow\[([A-Za-z0-9_,\s]+)\]\s*(.*)$"
+)
+REASON_RE = re.compile(r"reason\s*=\s*(\S.*)$")
+
+
+@dataclass
+class Finding:
+    rule: str          # e.g. "PL001"
+    file: str          # project-relative path (or absolute when outside)
+    line: int          # 1-indexed anchor line
+    message: str
+
+    def render(self, fmt: str = "text") -> str:
+        if fmt == "github":
+            # GitHub Actions workflow-command annotation: findings render
+            # inline on the PR diff.
+            return (f"::error file={self.file},line={self.line},"
+                    f"title=pstpu-lint {self.rule}::{self.message}")
+        return f"{self.file}:{self.line}: {self.rule} {self.message}"
+
+
+@dataclass
+class Waiver:
+    file: str
+    anchor_line: int       # the code line this waiver suppresses
+    comment_line: int      # where the comment itself sits
+    rules: Tuple[str, ...]
+    reason: str
+    used: set = field(default_factory=set)   # rule codes it suppressed
+
+
+def parse_waivers(relpath: str, source: str) -> List[Waiver]:
+    """Extract waiver comments with their anchor lines.
+
+    A waiver trailing code anchors to the START of that logical line (so a
+    trailing comment on a wrapped multi-line call suppresses the finding,
+    which is reported at the call's first line); a waiver alone on its
+    line anchors to the first line of the next statement.
+    """
+    # (comment line, text, start line of the logical line it trails or None)
+    comments: List[Tuple[int, str, Optional[int]]] = []
+    code_lines: set = set()
+    logical_start: Optional[int] = None
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type == tokenize.COMMENT:
+                comments.append((tok.start[0], tok.string, logical_start))
+            elif tok.type == tokenize.NEWLINE:
+                logical_start = None
+            elif tok.type not in (
+                tokenize.NL, tokenize.INDENT,
+                tokenize.DEDENT, tokenize.ENDMARKER, tokenize.ENCODING,
+            ):
+                if logical_start is None:
+                    logical_start = tok.start[0]
+                for ln in range(tok.start[0], tok.end[0] + 1):
+                    code_lines.add(ln)
+    except (tokenize.TokenError, SyntaxError):
+        # IndentationError (a SyntaxError subclass) escapes tokenize on
+        # dedent mismatches; the ast.parse pass turns the same file into a
+        # PL000 "does not parse" finding, so just skip waiver extraction.
+        return []
+
+    waivers = []
+    for line, text, stmt_start in comments:
+        m = WAIVER_RE.search(text)
+        if m is None:
+            continue
+        rules = tuple(
+            r.strip().upper() for r in m.group(1).split(",") if r.strip()
+        )
+        rm = REASON_RE.search(m.group(2))
+        reason = rm.group(1).strip() if rm else ""
+        if stmt_start is not None:
+            anchor = stmt_start
+        else:
+            following = [ln for ln in code_lines if ln > line]
+            anchor = min(following) if following else line
+        waivers.append(Waiver(
+            file=relpath, anchor_line=anchor, comment_line=line,
+            rules=rules, reason=reason,
+        ))
+    return waivers
+
+
+def collect_files(paths: Sequence[str]) -> List[str]:
+    """Expand files/directories into a sorted list of .py files."""
+    skip_dirs = {"__pycache__", ".git", ".venv", "node_modules", ".mypy_cache",
+                 ".ruff_cache", ".pytest_cache"}
+    out = []
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                out.append(os.path.abspath(path))
+        elif os.path.isdir(path):
+            for root, dirs, files in os.walk(path):
+                dirs[:] = sorted(d for d in dirs if d not in skip_dirs)
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        out.append(os.path.abspath(os.path.join(root, name)))
+        else:
+            raise FileNotFoundError(path)
+    return sorted(set(out))
+
+
+def _relpath(path: str, project_root: str) -> str:
+    rel = os.path.relpath(path, project_root)
+    return rel.replace(os.sep, "/")
+
+
+def _in_scope(relpath: str, scopes: Optional[Tuple[str, ...]]) -> bool:
+    if scopes is None:
+        return True
+    return any(
+        relpath == s or relpath.startswith(s.rstrip("/") + "/")
+        for s in scopes
+    )
+
+
+def default_project_root() -> str:
+    """The repo that owns this tools package — NOT the cwd. Scoped rules
+    match project-relative paths like 'production_stack_tpu/router/...';
+    anchoring to cwd would make `cd production_stack_tpu && python -m
+    tools.pstpu_lint server/` silently disable most rules and exit 0
+    falsely clean."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def run_lint(
+    paths: Sequence[str],
+    project_root: Optional[str] = None,
+    project_rules: bool = True,
+) -> List[Finding]:
+    """Lint ``paths``; returns the surviving findings (waivers applied),
+    including PL000 waiver-hygiene findings."""
+    from tools.pstpu_lint import rules as rules_mod
+
+    project_root = os.path.abspath(project_root or default_project_root())
+    files = collect_files(paths)
+    findings: List[Finding] = []
+    waivers: List[Waiver] = []
+
+    for path in files:
+        relpath = _relpath(path, project_root)
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        waivers.extend(parse_waivers(relpath, source))
+        try:
+            tree = ast.parse(source, filename=relpath)
+        except SyntaxError as e:
+            findings.append(Finding(
+                "PL000", relpath, e.lineno or 1,
+                f"file does not parse: {e.msg}",
+            ))
+            continue
+        for code, scopes, fn in rules_mod.FILE_RULES:
+            if _in_scope(relpath, scopes):
+                findings.extend(fn(relpath, tree, source))
+
+    if project_rules:
+        for code, wants, fn in rules_mod.PROJECT_RULES:
+            if wants(project_root):
+                findings.extend(fn(project_root))
+
+    # ---------------------------------------------------------- apply waivers
+    by_anchor: Dict[Tuple[str, int], List[Waiver]] = {}
+    for w in waivers:
+        by_anchor.setdefault((w.file, w.anchor_line), []).append(w)
+
+    surviving = []
+    for f in findings:
+        waived = False
+        for w in by_anchor.get((f.file, f.line), []):
+            if f.rule in w.rules:
+                w.used.add(f.rule)
+                waived = True
+        if not waived:
+            surviving.append(f)
+
+    # ------------------------------------------------------- waiver hygiene
+    for w in waivers:
+        if not w.reason:
+            surviving.append(Finding(
+                "PL000", w.file, w.comment_line,
+                f"waiver allow[{','.join(w.rules)}] has no reason= "
+                f"justification",
+            ))
+        stale = [r for r in w.rules if r not in w.used]
+        if stale and w.reason:
+            surviving.append(Finding(
+                "PL000", w.file, w.comment_line,
+                f"waiver allow[{','.join(stale)}] suppresses nothing "
+                f"(line {w.anchor_line}) — remove it",
+            ))
+
+    surviving.sort(key=lambda f: (f.file, f.line, f.rule))
+    return surviving
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.pstpu_lint",
+        description="Concurrency- and invariant-checking static analysis "
+                    "for the production-stack-tpu serving stack "
+                    "(docs/LINTING.md has the rule catalogue).",
+    )
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="files/directories to lint (default: "
+                             "production_stack_tpu tools benchmarks under "
+                             "the project root)")
+    parser.add_argument("--format", choices=["text", "github"],
+                        default="text",
+                        help="'github' emits ::error workflow-command "
+                             "annotations for inline PR rendering")
+    parser.add_argument("--project-root", default=None,
+                        help="root the per-rule path scopes and project "
+                             "rules resolve against (default: the repo "
+                             "containing tools/pstpu_lint, so running from "
+                             "a subdirectory cannot silently disable "
+                             "scoped rules)")
+    parser.add_argument("--no-project-rules", action="store_true",
+                        help="skip the repo-level passes (PL004 metrics "
+                             "consistency, PL006 flag drift)")
+    args = parser.parse_args(argv)
+
+    root = os.path.abspath(args.project_root or default_project_root())
+    paths = args.paths or [
+        os.path.join(root, p)
+        for p in ("production_stack_tpu", "tools", "benchmarks")
+        if os.path.exists(os.path.join(root, p))
+    ]
+    try:
+        findings = run_lint(
+            paths, project_root=args.project_root,
+            project_rules=not args.no_project_rules,
+        )
+    except FileNotFoundError as e:
+        print(f"pstpu-lint: no such path: {e}", file=sys.stderr)
+        return 2
+
+    for f in findings:
+        print(f.render(args.format))
+    if findings:
+        print(f"pstpu-lint: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    return 0

@@ -1,0 +1,78 @@
+"""Static argparse flag extraction, shared by PL006 and the docs generator.
+
+Walks ``add_argument`` calls with a constant ``--flag`` first argument and
+records the option string, dest, rendered default, and help text. Defaults
+that are not literals (e.g. ``os.environ.get(...)``) render as ``env``.
+"""
+
+import ast
+from dataclasses import dataclass
+from typing import List, Optional
+
+
+@dataclass
+class Flag:
+    option: str          # "--retry-max-attempts"
+    dest: str            # "retry_max_attempts"
+    default: str         # rendered default for docs tables
+    help: str
+    line: int
+
+
+def _const(node: ast.AST):
+    if isinstance(node, ast.Constant):
+        return node.value
+    return None
+
+
+def _render_default(node: Optional[ast.AST], action: Optional[str]) -> str:
+    if action in ("store_true",):
+        return "off"
+    if node is None:
+        return ""
+    if isinstance(node, ast.Constant):
+        if node.value is None:
+            return "unset"
+        return str(node.value)
+    if isinstance(node, (ast.List, ast.Tuple)):
+        vals = [_const(e) for e in node.elts]
+        if all(v is not None for v in vals):
+            return str(list(vals))
+        return "computed"
+    return "env" if "environ" in ast.dump(node) else "computed"
+
+
+def scan_flags(source: str) -> List[Flag]:
+    flags: List[Flag] = []
+    tree = ast.parse(source)
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add_argument"
+                and node.args):
+            continue
+        option = _const(node.args[0])
+        if not isinstance(option, str) or not option.startswith("-"):
+            continue
+        kw = {k.arg: k.value for k in node.keywords if k.arg}
+        action = _const(kw.get("action"))
+        dest = _const(kw.get("dest")) or option.lstrip("-").replace("-", "_")
+        help_text = _const(kw.get("help")) or ""
+        choices = kw.get("choices")
+        if choices is not None:
+            rendered = None
+            if isinstance(choices, (ast.List, ast.Tuple)):
+                vals = [_const(e) for e in choices.elts]
+                if all(v is not None for v in vals):
+                    rendered = ", ".join(str(v) for v in vals)
+            if rendered:
+                # ", "-joined, not "|": these strings land in markdown
+                # table cells where a raw pipe splits the row.
+                help_text = (f"{help_text} " if help_text else "") \
+                    + f"(choices: {rendered})"
+        flags.append(Flag(
+            option=option, dest=dest,
+            default=_render_default(kw.get("default"), action),
+            help=" ".join(help_text.split()), line=node.lineno,
+        ))
+    return flags

@@ -1,0 +1,195 @@
+"""Generate the docs metrics tables and README flag tables from the
+single-source registries, inside marker comments:
+
+    <!-- pstpu-metrics:BEGIN <group> -->  ...  <!-- pstpu-metrics:END <group> -->
+    <!-- pstpu-flags:BEGIN <tier> -->     ...  <!-- pstpu-flags:END <tier> -->
+
+Write mode refreshes the delimited blocks in place; ``--check`` reports
+stale/missing blocks without writing (the PL004 rule runs the metrics half
+of the check on every lint). Sources of truth:
+
+  * series: tools/pstpu_lint/metrics_registry.py
+  * flags:  the argparse definitions in router/parser.py and
+            server/api_server.py (tools/pstpu_lint/flags.py scans them)
+
+Usage: ``python -m tools.pstpu_lint.gen_docs [--check]``.
+"""
+
+import argparse
+import os
+import re
+import sys
+from typing import List, Optional, Tuple
+
+from tools.pstpu_lint import metrics_registry as reg
+from tools.pstpu_lint.flags import scan_flags
+
+# docs table group -> file carrying its marker block
+TABLES = {
+    "catalogue": "docs/METRICS.md",
+    "dispatch": "docs/PERF.md",
+    "disagg": "docs/DISAGG.md",
+    "resilience": "docs/RESILIENCE.md",
+}
+
+FLAG_TABLES = {
+    "router": ("README.md", "production_stack_tpu/router/parser.py"),
+    "engine": ("README.md", "production_stack_tpu/server/api_server.py"),
+}
+
+_SURFACE_NAMES = {
+    reg.ENGINE_TEXT: "engine /metrics",
+    reg.ENGINE_COLLECTOR: "engine collector",
+    reg.ROUTER: "router /metrics",
+}
+
+
+def render_metrics_table(group: str, registry=None) -> str:
+    registry = reg.REGISTRY if registry is None else registry
+    lines = [
+        "| Series | Type | Labels | Exported by | Meaning |",
+        "|---|---|---|---|---|",
+    ]
+    for s in registry:
+        if group not in s.docs:
+            continue
+        labels = ", ".join(s.labels_for(s.surfaces[0])) or "—"
+        exported = ", ".join(_SURFACE_NAMES[x] for x in s.surfaces)
+        lines.append(
+            f"| `{s.name}` | {s.kind} | {labels} | {exported} "
+            f"| {_cell(s.doc)} |"
+        )
+    return "\n".join(lines)
+
+
+def _cell(text: str) -> str:
+    """Escape raw pipes — inside a markdown table cell they split the row."""
+    return text.replace("|", "\\|")
+
+
+def render_flags_table(parser_source: str) -> str:
+    lines = [
+        "| Flag | Default | What it does |",
+        "|---|---|---|",
+    ]
+    for flag in scan_flags(parser_source):
+        default = flag.default or "—"
+        lines.append(
+            f"| `{flag.option}` | `{_cell(default)}` | {_cell(flag.help)} |"
+        )
+    return "\n".join(lines)
+
+
+def _block_re(kind: str, group: str) -> re.Pattern:
+    return re.compile(
+        rf"(<!-- pstpu-{kind}:BEGIN {re.escape(group)} -->)\n"
+        rf"(.*?)"
+        rf"(<!-- pstpu-{kind}:END {re.escape(group)} -->)",
+        re.S,
+    )
+
+
+def _update_block(text: str, kind: str, group: str,
+                  table: str) -> Optional[str]:
+    """New file text with the block replaced, or None if markers absent."""
+    pat = _block_re(kind, group)
+    if pat.search(text) is None:
+        return None
+    return pat.sub(
+        lambda m: m.group(1) + "\n" + table + "\n" + m.group(3),
+        text, count=1,
+    )
+
+
+def _iter_blocks(project_root: str, registry=None, kinds=None):
+    """Every generated block as (kind, group, relpath, path, table-or-None);
+    table is None when an input file is missing. ``kinds`` restricts which
+    table families are rendered (PL004 checks only the metrics tables,
+    PL006 only the flag tables — no point rendering the other half)."""
+    if kinds is None or "metrics" in kinds:
+        for group, relpath in TABLES.items():
+            path = os.path.join(project_root, relpath)
+            table = (render_metrics_table(group, registry)
+                     if os.path.exists(path) else None)
+            yield "metrics", group, relpath, path, table
+    if kinds is None or "flags" in kinds:
+        for tier, (relpath, parser_rel) in FLAG_TABLES.items():
+            path = os.path.join(project_root, relpath)
+            parser_path = os.path.join(project_root, parser_rel)
+            table = None
+            if os.path.exists(path) and os.path.exists(parser_path):
+                with open(parser_path, encoding="utf-8") as f:
+                    table = render_flags_table(f.read())
+            yield "flags", tier, relpath, path, table
+
+
+def _sync_blocks(project_root: str, registry=None,
+                 write: bool = False,
+                 kinds=None) -> List[Tuple[str, str, str]]:
+    """One pass over every block. write=False: report (group, relpath,
+    problem) per stale/missing block. write=True: refresh stale blocks in
+    place and report (group, relpath, "updated") per file written —
+    missing files/markers are reported identically in both modes, so
+    ``gen_docs`` and ``gen_docs --check`` can never disagree on a tree."""
+    out = []
+    for kind, group, relpath, path, table in _iter_blocks(
+        project_root, registry, kinds
+    ):
+        if table is None:
+            out.append((group, relpath, "missing (file not found)"))
+            continue
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        updated = _update_block(text, kind, group, table)
+        if updated is None:
+            out.append((group, relpath, "missing its marker block"))
+        elif updated != text:
+            if write:
+                with open(path, "w", encoding="utf-8") as f:
+                    f.write(updated)
+                out.append((group, relpath, "updated"))
+            else:
+                out.append((group, relpath, "out of date"))
+    return out
+
+
+def check_tables(project_root: str,
+                 registry=None) -> List[Tuple[str, str, str]]:
+    """(group, relpath, problem) for every stale/missing metrics block."""
+    return _sync_blocks(project_root, registry, kinds={"metrics"})
+
+
+def check_flag_tables(project_root: str) -> List[Tuple[str, str, str]]:
+    return _sync_blocks(project_root, kinds={"flags"})
+
+
+def write_tables(project_root: str) -> List[str]:
+    """Refresh every block in place; returns the files touched (and raises
+    nothing on missing files — they surface via --check / PL004)."""
+    return [relpath for _g, relpath, what in _sync_blocks(
+        project_root, write=True) if what == "updated"]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.pstpu_lint.gen_docs",
+        description="Regenerate docs metrics tables + README flag tables "
+                    "from the registries.",
+    )
+    p.add_argument("--check", action="store_true",
+                   help="report stale blocks without writing (exit 1)")
+    p.add_argument("--project-root", default=".")
+    args = p.parse_args(argv)
+    root = os.path.abspath(args.project_root)
+    if args.check:
+        problems = check_tables(root) + check_flag_tables(root)
+        for group, relpath, what in problems:
+            print(f"{relpath}: table {group!r} is {what}", file=sys.stderr)
+        return 1 if problems else 0
+    for relpath in write_tables(root):
+        print(f"updated {relpath}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,204 @@
+"""Canonical registry of every Prometheus series the stack exports.
+
+This is the single source of truth the PL004 metrics-drift rule checks the
+code against, and the input ``tools.pstpu_lint.gen_docs`` renders the docs
+metrics tables from. Three exporter surfaces:
+
+  * ``engine-text``      — the engine pod's hand-rolled /metrics renderer
+                           (production_stack_tpu/server/metrics.py, plus the
+                           histogram names in engine/metrics.py it renders);
+  * ``engine-collector`` — the prometheus_client Collector alternative
+                           (production_stack_tpu/engine/metrics.py);
+  * ``router``           — the router's prometheus_client module registry
+                           (production_stack_tpu/router/metrics.py).
+
+Naming convention: ``pstpu:`` for series this stack introduces, ``router_``
+for router data-plane outcomes, ``vllm:`` for the scraper/dashboard
+compatibility contract (the reference Grafana dashboard and the router's
+EngineStatsScraper parse these exact names — do NOT rename them).
+
+The two engine surfaces are parallel renderers of the same stats and MUST
+agree on names and label sets wherever both render a series; PL004 enforces
+that, and enforces that this file, the renderers, and the docs tables never
+drift from each other. To add a series: emit it in the renderer(s), add a
+``Series`` entry here, then run ``python -m tools.pstpu_lint.gen_docs`` to
+refresh the docs tables.
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, Tuple
+
+ALLOWED_PREFIXES = ("pstpu:", "router_", "vllm:")
+
+ENGINE_TEXT = "engine-text"
+ENGINE_COLLECTOR = "engine-collector"
+ROUTER = "router"
+
+
+@dataclass(frozen=True)
+class Series:
+    name: str
+    kind: str                       # gauge | counter | histogram
+    labels: Tuple[str, ...]         # label names on the engine surfaces
+    surfaces: Tuple[str, ...]       # which exporters render it
+    docs: Tuple[str, ...]           # docs table groups (gen_docs.TABLES)
+    doc: str                        # one-line meaning for the docs tables
+    # Router re-exports per-engine series under its own label set (the
+    # scraper relabels by backend); only set for the "router" surface.
+    router_labels: Tuple[str, ...] = field(default=())
+
+    def labels_for(self, surface: str) -> Tuple[str, ...]:
+        return self.router_labels if surface == ROUTER else self.labels
+
+
+_BOTH_ENGINE = (ENGINE_TEXT, ENGINE_COLLECTOR)
+
+REGISTRY: Tuple[Series, ...] = (
+    # ------------------------------------------------ engine: vllm compat
+    Series("vllm:num_requests_running", "gauge", ("model_name",),
+           _BOTH_ENGINE, ("catalogue",),
+           "Requests currently decoding"),
+    Series("vllm:num_requests_waiting", "gauge", ("model_name",),
+           _BOTH_ENGINE, ("catalogue",),
+           "Requests waiting for prefill"),
+    Series("vllm:gpu_cache_usage_perc", "gauge", ("model_name",),
+           _BOTH_ENGINE, ("catalogue",),
+           "KV-pool usage fraction (TPU HBM)"),
+    Series("vllm:gpu_prefix_cache_hits_total", "counter", ("model_name",),
+           _BOTH_ENGINE, ("catalogue",),
+           "Prefix-cache hit tokens"),
+    Series("vllm:gpu_prefix_cache_queries_total", "counter", ("model_name",),
+           _BOTH_ENGINE, ("catalogue",),
+           "Prefix-cache queried tokens"),
+    Series("vllm:num_preemptions_total", "counter", ("model_name",),
+           _BOTH_ENGINE, ("catalogue",),
+           "Sequences preempted"),
+    Series("vllm:prompt_tokens_total", "counter", ("model_name",),
+           _BOTH_ENGINE, ("catalogue",),
+           "Prefilled tokens"),
+    Series("vllm:generation_tokens_total", "counter", ("model_name",),
+           _BOTH_ENGINE, ("catalogue",),
+           "Generated tokens"),
+    Series("vllm:time_to_first_token_seconds", "histogram", ("model_name",),
+           (ENGINE_TEXT,), ("catalogue",),
+           "TTFT distribution (vLLM bucket boundaries)"),
+    Series("vllm:e2e_request_latency_seconds", "histogram", ("model_name",),
+           (ENGINE_TEXT,), ("catalogue",),
+           "End-to-end request latency distribution"),
+    # ------------------------------------------------ engine: pstpu series
+    Series("pstpu:engine_uptime_seconds", "gauge", ("model_name",),
+           _BOTH_ENGINE, ("catalogue",),
+           "Engine uptime"),
+    Series("pstpu:kv_offload_blocks", "gauge", ("model_name",),
+           _BOTH_ENGINE, ("catalogue",),
+           "KV blocks resident in the host offload pool"),
+    Series("pstpu:decode_dispatches_total", "counter", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "dispatch"),
+           "Fused decode dispatches issued"),
+    Series("pstpu:prefill_dispatches_total", "counter", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "dispatch"),
+           "Prefill chunk dispatches issued"),
+    Series("pstpu:dispatch_overlap_ratio", "gauge", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "dispatch"),
+           "Fraction of dispatch fetches with another dispatch outstanding"),
+    Series("pstpu:dispatch_gap_seconds_total", "counter", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "dispatch"),
+           "Host-observed seconds with no dispatch outstanding "
+           "(pipeline bubble)"),
+    Series("pstpu:disagg_role", "gauge", ("model_name", "role"),
+           _BOTH_ENGINE, ("catalogue", "disagg"),
+           "Engine disaggregation role (1 = active)"),
+    Series("pstpu:kv_handoffs_total", "counter", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "disagg"),
+           "Completed KV handoff transfers (published or consumed)"),
+    Series("pstpu:kv_handoff_bytes_total", "counter", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "disagg"),
+           "Bytes moved through the KV handoff plane"),
+    Series("pstpu:kv_handoff_seconds_total", "counter", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "disagg"),
+           "Seconds serializing/publishing/consuming KV handoffs"),
+    Series("pstpu:kv_handoff_failures_total", "counter", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "disagg"),
+           "Failed KV handoff transfers"),
+    # --------------------------------------------- router: vllm re-exports
+    Series("vllm:num_requests_running", "gauge", ("model_name",),
+           (ROUTER,), ("catalogue",),
+           "Running requests per engine (router view)",
+           router_labels=("server",)),
+    Series("vllm:num_requests_waiting", "gauge", ("model_name",),
+           (ROUTER,), ("catalogue",),
+           "Waiting requests per engine (router view)",
+           router_labels=("server",)),
+    Series("vllm:gpu_cache_usage_perc", "gauge", ("model_name",),
+           (ROUTER,), ("catalogue",),
+           "KV-pool usage per engine (router view)",
+           router_labels=("server",)),
+    Series("vllm:current_qps", "gauge", (), (ROUTER,), ("catalogue",),
+           "Router-observed QPS per engine", router_labels=("server",)),
+    Series("vllm:avg_decoding_length", "gauge", (), (ROUTER,), ("catalogue",),
+           "Average decoding length per engine", router_labels=("server",)),
+    Series("vllm:num_prefill_requests", "gauge", (), (ROUTER,),
+           ("catalogue",),
+           "In-prefill requests per engine", router_labels=("server",)),
+    Series("vllm:num_decoding_requests", "gauge", (), (ROUTER,),
+           ("catalogue",),
+           "In-decode requests per engine", router_labels=("server",)),
+    Series("vllm:healthy_pods_total", "gauge", (), (ROUTER,), ("catalogue",),
+           "Healthy engine pods", router_labels=("server",)),
+    Series("vllm:avg_latency", "gauge", (), (ROUTER,), ("catalogue",),
+           "Average end-to-end latency per engine",
+           router_labels=("server",)),
+    Series("vllm:avg_itl", "gauge", (), (ROUTER,), ("catalogue",),
+           "Average inter-token latency per engine",
+           router_labels=("server",)),
+    Series("vllm:num_requests_swapped", "gauge", (), (ROUTER,),
+           ("catalogue",),
+           "Swapped-out requests per engine", router_labels=("server",)),
+    Series("vllm:gpu_prefix_cache_hit_rate", "gauge", (), (ROUTER,),
+           ("catalogue",),
+           "Per-interval prefix-cache hit rate per engine",
+           router_labels=("server",)),
+    Series("vllm:router_queueing_delay_seconds", "gauge", (), (ROUTER,),
+           ("catalogue",),
+           "Router-side queueing delay (route decision to backend connect)",
+           router_labels=("server",)),
+    Series("vllm:router_ttft_seconds", "histogram", (), (ROUTER,),
+           ("catalogue",),
+           "Router-observed TTFT distribution", router_labels=("server",)),
+    Series("vllm:router_e2e_latency_seconds", "histogram", (), (ROUTER,),
+           ("catalogue",),
+           "Router-observed end-to-end latency distribution",
+           router_labels=("server",)),
+    Series("vllm:avg_prefill_length", "gauge", (), (ROUTER,), ("catalogue",),
+           "Average prompt length per engine", router_labels=("server",)),
+    # ------------------------------------------------ router: data plane
+    Series("router_retries_total", "counter", (), (ROUTER,),
+           ("catalogue", "resilience"),
+           "Pre-stream backend failures that triggered a retry",
+           router_labels=("server",)),
+    Series("router_failovers_total", "counter", (), (ROUTER,),
+           ("catalogue", "resilience"),
+           "Retries that moved the request away from this backend",
+           router_labels=("server",)),
+    Series("router_circuit_state", "gauge", (), (ROUTER,),
+           ("catalogue", "resilience"),
+           "Circuit breaker state (0 closed / 1 open / 2 half-open)",
+           router_labels=("server",)),
+    Series("router_deadline_exceeded_total", "counter", (), (ROUTER,),
+           ("catalogue", "resilience"),
+           "Deadline aborts (kind: ttft or total)",
+           router_labels=("server", "kind")),
+    Series("router_disagg_handoffs_total", "counter", (), (ROUTER,),
+           ("catalogue", "disagg"),
+           "Prefill->decode handoffs completed through the two-hop flow",
+           router_labels=()),
+    Series("router_disagg_fallbacks_total", "counter", (), (ROUTER,),
+           ("catalogue", "disagg"),
+           "Disagg-routed requests degraded to unified serving",
+           router_labels=("reason",)),
+)
+
+
+def by_surface(surface: str) -> Dict[str, Series]:
+    """name -> Series for one exporter surface."""
+    return {s.name: s for s in REGISTRY if surface in s.surfaces}

@@ -1,0 +1,39 @@
+"""Rule registry.
+
+FILE_RULES: (code, scope-prefixes or None, fn(relpath, tree, source)).
+PROJECT_RULES: (code, wants(project_root), fn(project_root)).
+
+Scopes are project-relative path prefixes; ``None`` means every linted
+file. The data-plane scope is where the event-loop/exception rules bite —
+the engine tier runs its blocking work on executors by design and is
+covered by the narrower rules only.
+"""
+
+from tools.pstpu_lint.rules import (
+    await_under_lock,
+    blocked_event_loop,
+    fire_and_forget,
+    flag_drift,
+    metrics_drift,
+    swallowed_exceptions,
+)
+
+DATA_PLANE_SCOPES = (
+    "production_stack_tpu/router",
+    "production_stack_tpu/server",
+    "production_stack_tpu/disagg",
+    "production_stack_tpu/kv_offload",
+)
+
+FILE_RULES = [
+    ("PL001", DATA_PLANE_SCOPES, blocked_event_loop.check),
+    ("PL002", None, fire_and_forget.check),
+    ("PL003", DATA_PLANE_SCOPES + ("production_stack_tpu/tracing.py",),
+     swallowed_exceptions.check),
+    ("PL005", None, await_under_lock.check),
+]
+
+PROJECT_RULES = [
+    ("PL004", metrics_drift.wants, metrics_drift.check),
+    ("PL006", flag_drift.wants, flag_drift.check),
+]

@@ -1,0 +1,66 @@
+"""PL005 await-under-lock: ``await`` while holding a ``threading.Lock``.
+
+A sync ``with lock:`` held across an ``await`` is a deadlock factory: the
+coroutine parks with the lock held, the scheduler thread (or any executor
+worker) that needs the same lock blocks forever, and the event loop happily
+keeps running everything EXCEPT the thing that would release it. The engine
+loop/runner/scheduler share state with the stats scrapers through
+threading locks, so this shape is reachable. ``async with
+asyncio.Lock()`` is the correct construct and is not flagged.
+"""
+
+import ast
+from typing import List
+
+from tools.pstpu_lint.core import Finding
+
+_LOCKISH = ("lock", "mutex")
+
+
+def _lock_name(expr: ast.AST) -> str:
+    """Terminal identifier of the context manager expression, if lock-ish."""
+    name = ""
+    if isinstance(expr, ast.Name):
+        name = expr.id
+    elif isinstance(expr, ast.Attribute):
+        name = expr.attr
+    elif isinstance(expr, ast.Call):
+        return _lock_name(expr.func)
+    low = name.lower()
+    return name if any(tok in low for tok in _LOCKISH) else ""
+
+
+def _awaits_in_body(with_node: ast.With):
+    stack = list(with_node.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue   # nested defs are separate execution contexts
+        if isinstance(node, ast.Await):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def check(relpath: str, tree: ast.AST, source: str) -> List[Finding]:
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.With):   # async with is fine
+            continue
+        held = [
+            _lock_name(item.context_expr) for item in node.items
+            if _lock_name(item.context_expr)
+        ]
+        if not held:
+            continue
+        for aw in _awaits_in_body(node):
+            # Anchored at the WITH line (where the fix — and a waiver —
+            # naturally goes), naming the await's own line in the message.
+            findings.append(Finding(
+                "PL005", relpath, node.lineno,
+                f"await (line {aw.lineno}) while holding threading lock "
+                f"{held[0]!r} — the coroutine can park with the lock held "
+                f"and deadlock every other thread; use asyncio.Lock or "
+                f"release before awaiting",
+            ))
+    return findings

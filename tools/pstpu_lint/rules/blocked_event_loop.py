@@ -1,0 +1,74 @@
+"""PL001 blocked-event-loop: sync I/O reachable inside ``async def`` bodies.
+
+The router is one process, one event loop; a single ``time.sleep`` or
+blocking ``requests.get`` in a handler stalls EVERY in-flight stream.
+Flagged calls: ``time.sleep``, builtin ``open``, ``socket.socket`` /
+``socket.create_connection``, ``subprocess.*``, ``requests.*``, and
+``urllib.request.urlopen`` — when the enclosing function body runs on the
+event loop per the module-local call graph (async defs + sync helpers they
+call). Thread targets and executor targets are exempt by construction: they
+are passed as values, never called from async context, so the call graph
+never seeds them (tools/pstpu_lint/callgraph.py).
+"""
+
+import ast
+from typing import List
+
+from tools.pstpu_lint.callgraph import CallGraph, _own_statements
+from tools.pstpu_lint.core import Finding
+
+_SUBPROCESS_FNS = {"run", "call", "check_call", "check_output", "Popen",
+                   "getoutput", "getstatusoutput"}
+_SOCKET_FNS = {"socket", "create_connection"}
+
+
+def _flagged_call(node: ast.Call) -> str:
+    """Return a human-readable name when this call blocks, else ''."""
+    fn = node.func
+    if isinstance(fn, ast.Name) and fn.id == "open":
+        return "open()"
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+        root, attr = fn.value.id, fn.attr
+        if root == "time" and attr == "sleep":
+            return "time.sleep()"
+        if root == "requests":
+            return f"requests.{attr}()"
+        if root == "subprocess" and attr in _SUBPROCESS_FNS:
+            return f"subprocess.{attr}()"
+        if root == "socket" and attr in _SOCKET_FNS:
+            return f"socket.{attr}()"
+    if (isinstance(fn, ast.Attribute) and fn.attr == "urlopen"
+            and isinstance(fn.value, ast.Attribute)
+            and fn.value.attr == "request"
+            and isinstance(fn.value.value, ast.Name)
+            and fn.value.value.id == "urllib"):
+        return "urllib.request.urlopen()"
+    return ""
+
+
+def check(relpath: str, tree: ast.AST, source: str) -> List[Finding]:
+    graph = CallGraph(tree)
+    chains = graph.async_context()
+    findings = []
+    for qual, chain in chains.items():
+        info = graph.functions[qual]
+        for node in _own_statements(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _flagged_call(node)
+            if not name:
+                continue
+            via = ""
+            if len(chain) > 1:
+                via = f" (reachable from async def {chain[0]} via " \
+                      f"{' -> '.join(chain[1:])})"
+            elif not info.is_async:
+                continue   # unreachable, defensive
+            else:
+                via = f" (inside async def {qual})"
+            findings.append(Finding(
+                "PL001", relpath, node.lineno,
+                f"{name} blocks the event loop{via}; use the async "
+                f"equivalent or run_in_executor",
+            ))
+    return findings

@@ -1,0 +1,70 @@
+"""PL002 fire-and-forget tasks: dropped ``asyncio.create_task`` handles.
+
+A task whose only reference is the event loop's weak set can be garbage
+collected mid-flight, and its exception is silently swallowed at GC time —
+the engine's KV-handoff publisher and the router's streaming pumps both
+learned this the hard way. Every created task must either be stored
+(``self._task = create_task(...)``, appended to a collection) or given an
+``add_done_callback``; a bare expression statement (or assignment to
+``_``) drops it.
+
+Receiver-aware: only ``asyncio.create_task``/``ensure_future``, bare
+imported names, and ``<something loop-ish>.create_task`` count — a domain
+method that happens to be called ``create_task`` (``self.scheduler.
+create_task(...)``) is not an asyncio spawn, and ``tg.create_task(...)``
+inside ``asyncio.TaskGroup`` holds a strong reference and propagates
+exceptions by design, so neither is flagged.
+"""
+
+import ast
+from typing import List
+
+from tools.pstpu_lint.core import Finding
+
+_SPAWN_FNS = {"create_task", "ensure_future"}
+
+
+def _loopish(name: str) -> bool:
+    return "loop" in name.lower()
+
+
+def _is_spawn(call: ast.Call) -> bool:
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        # `from asyncio import create_task` style; a same-named local
+        # function is a rare acceptable false positive (waivable).
+        return fn.id in _SPAWN_FNS
+    if isinstance(fn, ast.Attribute) and fn.attr in _SPAWN_FNS:
+        recv = fn.value
+        if isinstance(recv, ast.Name):
+            return recv.id == "asyncio" or _loopish(recv.id)
+        if isinstance(recv, ast.Attribute):
+            return _loopish(recv.attr)
+        if isinstance(recv, ast.Call):
+            f = recv.func
+            inner = (f.attr if isinstance(f, ast.Attribute)
+                     else f.id if isinstance(f, ast.Name) else "")
+            return _loopish(inner)   # asyncio.get_event_loop().create_task
+    return False
+
+
+def check(relpath: str, tree: ast.AST, source: str) -> List[Finding]:
+    findings = []
+    for node in ast.walk(tree):
+        call = None
+        if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+            call = node.value
+        elif (isinstance(node, ast.Assign)
+              and isinstance(node.value, ast.Call)
+              and all(isinstance(t, ast.Name) and t.id == "_"
+                      for t in node.targets)):
+            call = node.value
+        if call is None or not _is_spawn(call):
+            continue
+        findings.append(Finding(
+            "PL002", relpath, call.lineno,
+            "asyncio task handle is dropped — store it (or chain "
+            ".add_done_callback) so it cannot be GC'd mid-flight and its "
+            "exception is observed",
+        ))
+    return findings

@@ -1,0 +1,124 @@
+"""PL006 config-flag drift: every CLI flag is wired and documented.
+
+A flag defined in the router parser or the engine entrypoint but never read
+from the parsed namespace is dead config — operators set it, nothing
+changes, nobody notices (the reference stack shipped exactly this bug in
+its batch API). And a flag absent from README's flag tables is invisible
+config. Each ``add_argument("--x")`` must have:
+
+  * a reference: ``args.x`` / ``getattr(args, "x", ...)`` somewhere in the
+    parser's own tier (scoped per parser — the router and engine parsers
+    share dests like ``host``/``port``, so a package-wide search would let
+    one tier's dead flag hide behind the other tier's read);
+  * documentation: the literal ``--x`` appears in README.md (the generated
+    flag tables — ``python -m tools.pstpu_lint.gen_docs`` — keep this
+    satisfied automatically).
+"""
+
+import ast
+import os
+from typing import List, Set
+
+from tools.pstpu_lint.core import Finding
+from tools.pstpu_lint.flags import scan_flags
+
+# parser file -> package subtrees whose args.<dest> reads count for it.
+PARSER_FILES = {
+    "production_stack_tpu/router/parser.py":
+        ("production_stack_tpu/router",),
+    "production_stack_tpu/server/api_server.py":
+        ("production_stack_tpu/server",),
+}
+README = "README.md"
+
+
+def _referenced_dests(*scope_roots: str) -> Set[str]:
+    """Every attr read off a name called ``args`` (or via getattr on it)
+    under the given directories — the namespace objects argparse produces
+    are consistently called ``args`` in this codebase."""
+    paths: List[str] = []
+    for scope in scope_roots:
+        for root, dirs, files in os.walk(scope):
+            dirs[:] = [d for d in dirs if d != "__pycache__"]
+            paths += [os.path.join(root, n) for n in files
+                      if n.endswith(".py")]
+    dests: Set[str] = set()
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            try:
+                tree = ast.parse(f.read())
+            except SyntaxError:
+                continue
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "args"):
+                dests.add(node.attr)
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Name)
+                  and node.func.id == "getattr"
+                  and len(node.args) >= 2
+                  and isinstance(node.args[0], ast.Name)
+                  and node.args[0].id == "args"
+                  and isinstance(node.args[1], ast.Constant)):
+                dests.add(str(node.args[1].value))
+    return dests
+
+
+def check_flags(
+    project_root: str,
+    parser_files=None,
+    readme=README,
+) -> List[Finding]:
+    parser_files = PARSER_FILES if parser_files is None else parser_files
+    findings: List[Finding] = []
+    readme_path = os.path.join(project_root, readme)
+    with open(readme_path, encoding="utf-8") as f:
+        readme_text = f.read()
+
+    for rel, scopes in parser_files.items():
+        referenced = _referenced_dests(
+            *(os.path.join(project_root, s) for s in scopes)
+        )
+        with open(os.path.join(project_root, rel), encoding="utf-8") as f:
+            source = f.read()
+        for flag in scan_flags(source):
+            if flag.dest not in referenced:
+                findings.append(Finding(
+                    "PL006", rel, flag.line,
+                    f"flag {flag.option} is defined but args.{flag.dest} is "
+                    f"never read in {', '.join(scopes)} — dead config "
+                    f"(wire it or delete it)",
+                ))
+            if flag.option not in readme_text:
+                findings.append(Finding(
+                    "PL006", rel, flag.line,
+                    f"flag {flag.option} is not documented in {readme} — "
+                    f"regenerate the flag tables "
+                    f"(python -m tools.pstpu_lint.gen_docs)",
+                ))
+    return findings
+
+
+# ------------------------------------------------------------- registration
+def wants(project_root: str) -> bool:
+    return all(
+        os.path.exists(os.path.join(project_root, p))
+        for p in tuple(PARSER_FILES) + (README,)
+    )
+
+
+def check(project_root: str) -> List[Finding]:
+    findings = check_flags(project_root)
+    # Freshness of the GENERATED README flag tables is part of this rule
+    # (PL006's documentation leg would otherwise stay green on a stale
+    # table whose '--flag' literals still match).
+    from tools.pstpu_lint import gen_docs
+
+    for tier, relpath, what in gen_docs.check_flag_tables(project_root):
+        findings.append(Finding(
+            "PL006", relpath, 1,
+            f"README flag table {tier!r} is {what}; run "
+            f"python -m tools.pstpu_lint.gen_docs",
+        ))
+    return findings

@@ -1,0 +1,105 @@
+"""PL003 swallowed exceptions: silent catch-alls in the data plane.
+
+``except Exception: pass`` in the router/server/disagg/kv_offload tiers
+turns backend failures into invisible ones — the resilience layer can only
+open circuits and the operator can only alert on what is logged or counted.
+A catch-all handler (bare ``except:``, ``except Exception``, ``except
+BaseException``) must do at least one of:
+
+  * re-raise (``raise``),
+  * log (any ``logger.*`` / ``logging.*`` call),
+  * bump a metric (``.inc()`` / ``.observe()``, a metric-receiver
+    ``.set()``, or an ``x += ...`` on a ``*_total`` counter attribute),
+  * actually use the caught exception (``except Exception as e`` with ``e``
+    read in the body — returning a 400 carrying ``{e}`` or relaying it over
+    a queue surfaces the failure; it is not swallowed).
+
+Returning a bare fallback value alone is not evidence — that is exactly
+the silent-degradation shape this rule exists to catch.
+"""
+
+import ast
+from typing import List
+
+from tools.pstpu_lint.core import Finding
+
+_LOG_METHODS = {"debug", "info", "warning", "error", "exception",
+                "critical", "log"}
+# .inc()/.observe() only exist on metric objects; .set() also exists on
+# threading/asyncio Event — a shutdown signal is NOT failure evidence, so
+# .set() counts only when its receiver looks like a metric (a .labels(...)
+# chain or a metric/gauge/counter-ish name).
+_METRIC_METHODS = {"inc", "observe"}
+_METRICISH = ("metric", "gauge", "counter", "histogram")
+
+
+def _metricish_receiver(expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "labels":
+            return True
+        name = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        if name and any(tok in name.lower() for tok in _METRICISH):
+            return True
+    return False
+
+
+def _is_catch_all(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+    elif isinstance(t, ast.Name):
+        names = [t.id]
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+def _has_evidence(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if (handler.name and isinstance(node, ast.Name)
+                and node.id == handler.name
+                and isinstance(node.ctx, ast.Load)):
+            return True
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            if attr in _METRIC_METHODS:
+                return True
+            if attr == "set" and _metricish_receiver(node.func.value):
+                return True
+            if attr in _LOG_METHODS:
+                root = node.func.value
+                # logger.warning(...), logging.warning(...),
+                # self.logger.info(...), metrics-ish chains all count.
+                if isinstance(root, (ast.Name, ast.Attribute)):
+                    return True
+        if isinstance(node, ast.AugAssign) and isinstance(node.op, ast.Add):
+            target = node.target
+            if (isinstance(target, ast.Attribute)
+                    and target.attr.endswith("_total")):
+                return True
+    return False
+
+
+def check(relpath: str, tree: ast.AST, source: str) -> List[Finding]:
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_catch_all(node):
+            continue
+        if _has_evidence(node):
+            continue
+        findings.append(Finding(
+            "PL003", relpath, node.lineno,
+            "catch-all except swallows the exception silently — log it, "
+            "bump a metric, or narrow the except type",
+        ))
+    return findings
