@@ -34,19 +34,36 @@ import time
 PEAK_HBM_GBS = float(os.environ.get("PSTPU_PEAK_HBM_GBS", 819.0))
 
 
-def _roofline_tok_s(model: str, dtype_bytes: float, batch: int,
-                    avg_ctx: float) -> float:
-    """Aggregate decode roofline from the model's analytic byte counts."""
+def roofline_components(model: str, weight_dtype_bytes: float,
+                        kv_cache_dtype: str, batch: int, avg_ctx: float,
+                        peak_gbs: float = None) -> dict:
+    """Aggregate decode roofline from the model's analytic byte counts —
+    WEIGHT bytes (compute dtype, amortized over the batch) split from KV
+    bytes (the KV-CACHE storage dtype + per-slot scale overhead, per row):
+    int8 KV halves the depth-dominant term, which is why the roofline
+    itself roughly doubles at long context. Pure function (unit-pinned by
+    tests/test_kv_quant.py)."""
+    from production_stack_tpu.engine.config import EngineConfig
     from production_stack_tpu.models.config import resolve_model_config
 
+    peak = PEAK_HBM_GBS if peak_gbs is None else peak_gbs
     mc = resolve_model_config(model)
     d, f, v = mc.hidden_size, mc.intermediate_size, mc.vocab_size
     dh, h, hkv, nl = mc.head_dim_, mc.num_heads, mc.num_kv_heads, mc.num_layers
     per_layer = d * (h * dh) + 2 * d * (hkv * dh) + (h * dh) * d + 3 * d * f
     embed = v * d * (1 if mc.tie_word_embeddings else 2)
-    param_bytes = (nl * per_layer + embed) * dtype_bytes
-    kv_bytes_per_tok = 2 * nl * hkv * dh * dtype_bytes * avg_ctx
-    return PEAK_HBM_GBS * 1e9 / (param_bytes / batch + kv_bytes_per_tok)
+    param_bytes = (nl * per_layer + embed) * weight_dtype_bytes
+    kv_bytes_per_token = EngineConfig(
+        kv_cache_dtype=kv_cache_dtype
+    ).kv_cache_bytes_per_token(mc)
+    step_bytes_per_row = param_bytes / batch + kv_bytes_per_token * avg_ctx
+    return {
+        "kv_cache_dtype": kv_cache_dtype,
+        "param_bytes": param_bytes,
+        "kv_bytes_per_token": kv_bytes_per_token,
+        "kv_bytes_per_step_per_row": kv_bytes_per_token * avg_ctx,
+        "roofline_tok_s": peak * 1e9 / step_bytes_per_row,
+    }
 
 
 # Byte-level fallback tokenizer yield: ~150 words of filler tokenize to
@@ -132,6 +149,7 @@ def bench_stack(args) -> dict:
             "--max-model-len", str(args.max_model_len),
             "--max-num-seqs", str(max(8, args.users)),
             "--attn-impl", args.attn_impl,
+            "--kv-cache-dtype", args.kv_cache_dtype,
             *(["--decode-loop", args.decode_loop]
               if args.decode_loop else []),
             *(["--no-overlap-dispatch"] if args.no_overlap else []),
@@ -206,6 +224,7 @@ def bench_disagg(args) -> dict:
                 "--max-model-len", str(args.max_model_len),
                 "--max-num-seqs", str(max(8, args.users)),
                 "--attn-impl", args.attn_impl,
+                "--kv-cache-dtype", args.kv_cache_dtype,
                 *(["--no-warmup"] if getattr(args, "backend", "") == "cpu"
                   else []),
             ],
@@ -384,6 +403,7 @@ def bench_engine(args) -> dict:
         max_num_seqs=max(8, args.users),
         max_num_batched_tokens=1024,
         num_kv_blocks=None if on_tpu else 2048,
+        kv_cache_dtype=args.kv_cache_dtype,
         **({"decode_loop": args.decode_loop} if args.decode_loop else {}),
         overlap_dispatch=not args.no_overlap,
     )
@@ -436,6 +456,11 @@ def main():
     ap.add_argument("--attn-impl", default="auto",
                     choices=["auto", "window", "paged", "xla", "pallas"],
                     help="A/B the decode attention implementation")
+    ap.add_argument("--kv-cache-dtype", default="bfloat16",
+                    choices=["bfloat16", "int8"],
+                    help="KV-cache storage dtype for the engines AND the "
+                         "roofline's KV term (int8 halves decode KV bytes "
+                         "— docs/PERF.md round 7)")
     # Per-user seeded chat history (reference shape: 20k tokens — request
     # --history-tokens 20000 --max-model-len 32768; the default fits the
     # default 8192 context). Makes kv_hit_rate a measured quantity.
@@ -489,8 +514,11 @@ def main():
         EngineConfig().dtype
     ]
     avg_ctx = res["avg_prompt_tokens"] + args.max_tokens / 2
-    roofline = _roofline_tok_s(args.model, dtype_bytes, max(1, args.users),
-                               avg_ctx)
+    comp = roofline_components(
+        args.model, dtype_bytes, args.kv_cache_dtype, max(1, args.users),
+        avg_ctx,
+    )
+    roofline = comp["roofline_tok_s"]
     out = {
         "metric": res["metric"],
         "value": res["value"],
@@ -498,6 +526,13 @@ def main():
         "vs_baseline": round(res["value"] / roofline, 3),
         "roofline_tok_s": round(roofline, 1),
         "hbm_bw_pct": round(100 * res["value"] / roofline, 1),
+        # Roofline byte components (satellite: the KV term follows the
+        # KV-cache dtype; weights stay in the compute dtype).
+        "kv_cache_dtype": args.kv_cache_dtype,
+        "roofline_param_bytes": round(comp["param_bytes"]),
+        "roofline_kv_bytes_per_token": comp["kv_bytes_per_token"],
+        "roofline_kv_bytes_per_step_per_row":
+            round(comp["kv_bytes_per_step_per_row"]),
         "p50_ttft_s": round(summary["p50_ttft_s"], 4)
         if summary.get("p50_ttft_s") else None,
         "total_output_tokens": summary["total_output_tokens"],

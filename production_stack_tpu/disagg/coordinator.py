@@ -60,9 +60,11 @@ class DisaggCoordinator:
         carries the final text + finish reason for verbatim replay."""
         t0 = time.monotonic()
         try:
-            k = v = None
+            k = v = k_sc = v_sc = None
             if not seq.status.is_finished and seq.block_ids:
-                k, v = self.runner.read_blocks_retry(seq.block_ids)
+                k, v, k_sc, v_sc = self.runner.read_blocks_retry(
+                    seq.block_ids
+                )
             mani = HandoffManifest(
                 request_id=seq.request_id,
                 prompt_token_ids=list(seq.prompt_token_ids),
@@ -78,7 +80,8 @@ class DisaggCoordinator:
                     seq.finish_reason() if seq.status.is_finished else None
                 ),
                 final_text=final_text if seq.status.is_finished else None,
-                k=k, v=v,
+                kv_cache_dtype=self.config.kv_cache_dtype,
+                k=k, v=v, k_scale=k_sc, v_scale=v_sc,
             )
             blob = pack_manifest(mani, self.serde)
             if not self.transfer.publish(seq.handoff_key, blob):

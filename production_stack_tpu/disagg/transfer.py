@@ -64,9 +64,18 @@ class HandoffManifest:
     # hop replays these verbatim (no KV rides along in that case).
     finish_reason: Optional[str] = None
     final_text: Optional[str] = None
-    # KV payload: [n_blocks, L, Hkv, bs, Dh] arrays (None when finished).
+    # KV-cache storage dtype of the published blocks (engine/config.py
+    # kv_cache_dtype): the decode hop validates it against its own pool —
+    # int8 blocks rehydrate bit-identically into an int8 pool with zero
+    # recompute, and a mismatched bundle is rejected (the router degrades
+    # to unified serving) rather than silently re-encoded.
+    kv_cache_dtype: str = "bfloat16"
+    # KV payload: [n_blocks, L, Hkv, bs, Dh] arrays (None when finished);
+    # int8 bundles carry the per-(slot, head) scales [n_blocks, L, Hkv, bs].
     k: Optional[np.ndarray] = field(default=None, repr=False)
     v: Optional[np.ndarray] = field(default=None, repr=False)
+    k_scale: Optional[np.ndarray] = field(default=None, repr=False)
+    v_scale: Optional[np.ndarray] = field(default=None, repr=False)
 
     @property
     def num_blocks(self) -> int:
@@ -85,13 +94,18 @@ def pack_manifest(mani: HandoffManifest, serde: str = "naive") -> bytes:
         "model": mani.model,
         "finish_reason": mani.finish_reason,
         "final_text": mani.final_text,
+        "kv_cache_dtype": mani.kv_cache_dtype,
         "serde": serde,
     }
     hdr = json.dumps(header).encode()
     parts = [_MAGIC, struct.pack("<I", len(hdr)), hdr]
     n = mani.num_blocks
     for i in range(n):
-        blob = pack(np.asarray(mani.k[i]), np.asarray(mani.v[i]))
+        blob = pack(
+            np.asarray(mani.k[i]), np.asarray(mani.v[i]),
+            None if mani.k_scale is None else np.asarray(mani.k_scale[i]),
+            None if mani.v_scale is None else np.asarray(mani.v_scale[i]),
+        )
         parts.append(struct.pack("<Q", len(blob)))
         parts.append(blob)
     return b"".join(parts)
@@ -105,13 +119,16 @@ def unpack_manifest(blob: bytes) -> HandoffManifest:
     header = json.loads(blob[off:off + hlen].decode())
     off += hlen
     _, unpack = get_serde(header.get("serde", "naive"))
-    ks, vs = [], []
+    ks, vs, kss, vss = [], [], [], []
     while off < len(blob):
         (blen,) = struct.unpack_from("<Q", blob, off)
         off += 8
-        k, v = unpack(blob[off:off + blen])
+        k, v, k_sc, v_sc = unpack(blob[off:off + blen])
         ks.append(k)
         vs.append(v)
+        if k_sc is not None:
+            kss.append(k_sc)
+            vss.append(v_sc)
         off += blen
     return HandoffManifest(
         request_id=header["request_id"],
@@ -123,8 +140,11 @@ def unpack_manifest(blob: bytes) -> HandoffManifest:
         model=header["model"],
         finish_reason=header.get("finish_reason"),
         final_text=header.get("final_text"),
+        kv_cache_dtype=header.get("kv_cache_dtype", "bfloat16"),
         k=np.stack(ks) if ks else None,
         v=np.stack(vs) if vs else None,
+        k_scale=np.stack(kss) if kss else None,
+        v_scale=np.stack(vss) if vss else None,
     )
 
 

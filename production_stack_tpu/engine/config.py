@@ -18,6 +18,14 @@ class EngineConfig:
     dtype: str = "bfloat16"
     max_model_len: int = 2048
     # --- KV cache ---
+    # Pool STORAGE dtype (compute stays self.dtype): "int8" stores K/V as
+    # symmetric int8 with a per-(slot, head) bf16 scale
+    # (ops/quantization.py), halving decode HBM byte traffic — the decode
+    # roofline itself — and kv_offload/disagg wire bytes; the pool holds
+    # ~2x the blocks in the same HBM budget. Readers dequantize inline
+    # (window gather / XLA reference path / Pallas flash-decode kernel);
+    # bf16 K/V never materialize in HBM on the paged path.
+    kv_cache_dtype: str = "bfloat16"
     block_size: int = 16
     num_kv_blocks: Optional[int] = None     # explicit block count; else derived
     hbm_utilization: float = 0.9            # fraction of free HBM for KV pool
@@ -186,7 +194,11 @@ class EngineConfig:
         # wins once the live KV is large (llama-3b @ 8k: 451 vs 245 tok/s,
         # and window cannot represent 32k x batch at all). Cross over when
         # the worst-case window (every sequence at max_model_len) exceeds
-        # ~4 GiB (between those two measured points).
+        # ~4 GiB (between those two measured points). Costed in COMPUTE-
+        # dtype bytes even for int8 pools: the gathered window materializes
+        # DEQUANTIZED (gather_window out_dtype), so its HBM footprint — the
+        # quantity the ~4 GiB crossover was tuned against — does not shrink
+        # with the storage dtype.
         import jax.numpy as jnp
 
         worst_window_bytes = (
@@ -195,6 +207,43 @@ class EngineConfig:
             * self.max_model_len * self.max_num_seqs
         )
         return "paged" if worst_window_bytes > (4 << 30) else "window"
+
+    def kv_cache_bytes_per_token(self, model_config) -> int:
+        """Pool bytes one token occupies across all layers: K + V payload
+        in the pool's STORAGE dtype plus per-(slot, head) scale overhead
+        when quantized (ops/quantization.py). Unquantized pools store the
+        COMPUTE dtype (float32 pools cost 4 B/element, not bf16's 2). The
+        single source for block sizing, engine.stats() pool-bytes
+        reporting, and the bench roofline's KV term."""
+        import jax.numpy as jnp
+
+        from production_stack_tpu.ops.quantization import SCALE_ITEMSIZE
+
+        if self.kv_cache_quantized:
+            per_slot = model_config.head_dim_ + SCALE_ITEMSIZE
+        else:
+            per_slot = (
+                model_config.head_dim_ * jnp.dtype(self.dtype).itemsize
+            )
+        return (
+            2 * model_config.num_layers * model_config.num_kv_heads
+            * per_slot
+        )
+
+    def kv_cache_bytes_per_block(self, model_config) -> int:
+        """Pool bytes one KV block occupies (block_size tokens)."""
+        return self.block_size * self.kv_cache_bytes_per_token(model_config)
+
+    @property
+    def kv_cache_quantized(self) -> bool:
+        from production_stack_tpu.ops.quantization import KV_CACHE_DTYPES
+
+        if self.kv_cache_dtype not in KV_CACHE_DTYPES:
+            raise ValueError(
+                f"Unknown kv_cache_dtype {self.kv_cache_dtype!r} "
+                f"(supported: {', '.join(KV_CACHE_DTYPES)})"
+            )
+        return self.kv_cache_dtype == "int8"
 
     @property
     def model_name(self) -> str:

@@ -348,6 +348,16 @@ class ServingEngine:
                 f"handoff block_size {mani.block_size} != engine block_size "
                 f"{self.config.block_size} (pools must share the KV layout)"
             )
+        if mani.kv_cache_dtype != self.config.kv_cache_dtype:
+            # Mixed-dtype role pools must not splice KV: the decode engine
+            # would reconstruct different values than the prefill engine
+            # computed. Rejecting here surfaces a retryable failure the
+            # router degrades to unified serving.
+            raise ValueError(
+                f"handoff kv_cache_dtype {mani.kv_cache_dtype!r} != engine "
+                f"kv_cache_dtype {self.config.kv_cache_dtype!r} (role-split "
+                f"pools must share --kv-cache-dtype)"
+            )
         bs = self.config.block_size
         need = mani.num_blocks
         if (
@@ -631,7 +641,7 @@ class ServingEngine:
                 if mani.num_blocks:
                     await loop.run_in_executor(
                         None, self.runner.write_blocks, blocks, mani.k,
-                        mani.v,
+                        mani.v, mani.k_scale, mani.v_scale,
                     )
                 seq.num_computed_tokens = mani.num_computed_tokens
                 seq.num_cached_tokens = mani.num_computed_tokens
@@ -850,6 +860,15 @@ class ServingEngine:
             **disagg,
             "engine_uptime_seconds": time.monotonic() - self.start_time,
             "kv_offload_blocks": self.offload_blocks_resident,
+            # KV-cache quantization (--kv-cache-dtype, docs/PERF.md round
+            # 7): the pool's storage dtype, its DERIVED device bytes
+            # (payload + scale sidecars — int8 buys ~2x blocks per byte),
+            # and the pool bytes quantization avoided writing.
+            "kv_cache_dtype": self.config.kv_cache_dtype,
+            "kv_pool_bytes": self.runner.kv_pool_bytes,
+            "kv_num_blocks": self.runner.num_kv_blocks,
+            "kv_quant_bytes_saved_total":
+                self.runner.kv_quant_bytes_saved_total,
             "num_requests_running": self.scheduler.num_running,
             "num_requests_waiting": self.scheduler.num_waiting,
             "kv_cache_usage": self.block_manager.usage(),

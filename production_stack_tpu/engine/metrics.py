@@ -92,6 +92,24 @@ class EngineMetricsCollector(Collector):
         )
         role_g.add_metric([eng.config.model_name, role], 1)
         yield role_g
+        # KV-cache quantization (--kv-cache-dtype): the pool's storage
+        # dtype as an info-style gauge (same shape as pstpu:disagg_role)
+        # and the pool bytes quantization avoided writing.
+        kv_dtype = getattr(eng.config, "kv_cache_dtype", "bfloat16") \
+            or "bfloat16"
+        dtype_g = GaugeMetricFamily(
+            "pstpu:kv_cache_dtype",
+            "KV-cache storage dtype of the block pool (1 = active)",
+            labels=["model_name", "kv_cache_dtype"],
+        )
+        dtype_g.add_metric([eng.config.model_name, kv_dtype], 1)
+        yield dtype_g
+        yield counter(
+            "pstpu:kv_quant_bytes_saved_total",
+            "KV-pool bytes the quantized cache avoided writing vs the "
+            "compute dtype",
+            getattr(eng.runner, "kv_quant_bytes_saved_total", 0),
+        )
         disagg = getattr(eng, "disagg", None)
         d = disagg.stats() if disagg is not None else {}
         yield counter("pstpu:kv_handoffs_total",

@@ -180,6 +180,14 @@ class ModelRunner:
         self.attn_impl = config.resolved_attn_impl(model_config)
         self._pallas_interpret = jax.default_backend() in ("cpu",)
         self.dtype = _dtype(config.dtype)
+        # KV-cache STORAGE dtype (--kv-cache-dtype): int8 pools carry a
+        # per-(slot, head) bf16 scale sidecar (ops/quantization.py) and
+        # every reader dequantizes inline; compute stays self.dtype.
+        self.kv_quantized = config.kv_cache_quantized
+        self.kv_store_dtype = jnp.int8 if self.kv_quantized else self.dtype
+        # Tokens written to a quantized pool (prefill + fused decode +
+        # block restores), for the pstpu:kv_quant_bytes_saved_total series.
+        self.kv_quant_tokens_written = 0
         if config.compilation_cache_dir:
             _setup_compilation_cache(config.compilation_cache_dir)
 
@@ -210,14 +218,7 @@ class ModelRunner:
 
         self.num_kv_blocks = num_kv_blocks or config.num_kv_blocks or \
             self._derive_num_blocks()
-        num_slots = self.num_kv_blocks * config.block_size
-        kv_shape = (
-            model_config.num_layers, model_config.num_kv_heads,
-            num_slots, model_config.head_dim_,
-        )
-        kv_sh = kv_pool_sharding(model_config, mesh)
-        self.kv_k = jax.device_put(jnp.zeros(kv_shape, self.dtype), kv_sh)
-        self.kv_v = jax.device_put(jnp.zeros(kv_shape, self.dtype), kv_sh)
+        self._alloc_kv_pools()
 
         from production_stack_tpu.parallel.mesh import AXIS_SP
 
@@ -233,7 +234,7 @@ class ModelRunner:
             self._decode_impl,
             static_argnames=("b", "mb", "num_steps", "use_cached_window",
                              "has_penalties", "logprobs_k"),
-            donate_argnums=(2, 3, 4, 5),
+            donate_argnums=(2, 3, 4, 5, 6, 7),
         )
         # Persistent decode window (window impl only): consecutive decode
         # dispatches over the SAME rows reuse the gathered window and append
@@ -273,14 +274,74 @@ class ModelRunner:
             self._prefill_impl,
             static_argnames=("b", "t", "mb", "has_window", "b_max",
                              "has_penalties", "logprobs_k"),
-            donate_argnums=(2, 3),
+            donate_argnums=(2, 3, 4, 5),
         )
 
     # ------------------------------------------------------------------ sizing
-    def _derive_num_blocks(self) -> int:
-        """Size the KV pool from free device memory (TPU HBM)."""
+    def _alloc_kv_pools(self) -> None:
+        """(Re)build the device KV pools: payload in the KV-cache storage
+        dtype, plus — quantized mode — the per-(slot, head) dequant scale
+        sidecars, kv-head-sharded like the payload."""
         mc, cfg = self.model_config, self.config
-        bytes_per_block = (
+        num_slots = self.num_kv_blocks * cfg.block_size
+        kv_shape = (mc.num_layers, mc.num_kv_heads, num_slots, mc.head_dim_)
+        kv_sh = kv_pool_sharding(mc, self.mesh)
+        self.kv_k = jax.device_put(
+            jnp.zeros(kv_shape, self.kv_store_dtype), kv_sh
+        )
+        self.kv_v = jax.device_put(
+            jnp.zeros(kv_shape, self.kv_store_dtype), kv_sh
+        )
+        if self.kv_quantized:
+            from production_stack_tpu.ops.quantization import SCALE_DTYPE
+            from production_stack_tpu.parallel import kv_scale_sharding
+
+            sc_shape = kv_shape[:-1]
+            sc_sh = kv_scale_sharding(mc, self.mesh)
+            self.kv_k_scale = jax.device_put(
+                jnp.zeros(sc_shape, SCALE_DTYPE), sc_sh
+            )
+            self.kv_v_scale = jax.device_put(
+                jnp.zeros(sc_shape, SCALE_DTYPE), sc_sh
+            )
+        else:
+            self.kv_k_scale = self.kv_v_scale = None
+
+    @property
+    def kv_pool_bytes(self) -> int:
+        """Derived device bytes of the KV pool (payload + scale sidecars) —
+        surfaced through engine.stats() so operators can see what an int8
+        pool actually bought at equal HBM budget."""
+        return self.num_kv_blocks * self.config.kv_cache_bytes_per_block(
+            self.model_config
+        )
+
+    @property
+    def kv_quant_bytes_saved_total(self) -> int:
+        """Monotonic counter: pool bytes a quantized cache avoided writing
+        versus storing the same tokens in the compute dtype (0 when the KV
+        cache is not quantized)."""
+        if not self.kv_quantized:
+            return 0
+        mc, cfg = self.model_config, self.config
+        unquantized = (
+            2 * mc.num_layers * mc.num_kv_heads * mc.head_dim_
+            * jnp.dtype(self.dtype).itemsize
+        )
+        saved = max(0, unquantized - cfg.kv_cache_bytes_per_token(mc))
+        return self.kv_quant_tokens_written * saved
+
+    def _derive_num_blocks(self) -> int:
+        """Size the KV pool from free device memory (TPU HBM).
+
+        Pool bytes follow the KV-CACHE storage dtype (+ per-slot scale
+        overhead when quantized — config.kv_cache_bytes_per_block), so an
+        int8 pool holds ~2x the blocks of a bf16 pool in the same budget.
+        The gathered decode/prefill WINDOW is a dequantized compute-dtype
+        copy, so its reservation is costed in compute-dtype bytes."""
+        mc, cfg = self.model_config, self.config
+        bytes_per_block = cfg.kv_cache_bytes_per_block(mc)
+        window_bytes_per_block = (
             2 * mc.num_layers * cfg.block_size * mc.num_kv_heads
             * mc.head_dim_ * jnp.dtype(self.dtype).itemsize
         )
@@ -293,29 +354,36 @@ class ModelRunner:
             pass
         if free_bytes is None:
             free_bytes = 2 << 30  # conservative default when unprobeable
-        budget_blocks = int(free_bytes * cfg.hbm_utilization) // bytes_per_block
+        budget = int(free_bytes * cfg.hbm_utilization)
         if self.attn_impl == "window":
-            # The decode window is a gathered copy of the live KV (up to the
-            # whole pool), so budget for pool + window rather than pool alone.
-            # The scheduler additionally caps each dispatch's bucketed
-            # rows x blocks window at pool size (window budgets below).
-            n = budget_blocks // 2
+            # The decode window is a gathered (dequantized) copy of the live
+            # KV (up to the whole pool), so budget for pool + window rather
+            # than pool alone. The scheduler additionally caps each
+            # dispatch's bucketed rows x blocks window at pool size (window
+            # budgets below).
+            n = budget // (bytes_per_block + window_bytes_per_block)
         else:
             # Paged decode never copies the pool, but chunked PREFILL still
             # gathers a [rows, max_blocks] history window; reserve the
             # worst-case bucketed prefill window out of the pool budget.
-            reserve = min(
+            reserve_bytes = min(
                 _bucket(cfg.max_prefill_seqs, 1, max(1, cfg.max_num_seqs))
                 * _bucket(cfg.max_blocks_per_seq, 1,
-                          max(1, cfg.max_blocks_per_seq)),
-                budget_blocks // 2,
+                          max(1, cfg.max_blocks_per_seq))
+                * window_bytes_per_block,
+                budget // 2,
             )
-            self._prefill_window_blocks = max(1, reserve)
-            n = budget_blocks - reserve
+            self._prefill_window_blocks = max(
+                1, reserve_bytes // window_bytes_per_block
+            )
+            n = (budget - reserve_bytes) // bytes_per_block
         n = max(2, min(n, cfg.max_blocks_per_seq * cfg.max_num_seqs + 1))
-        logger.info("KV pool: %d blocks x %d tokens (%.1f MiB, attn=%s)",
-                    n, cfg.block_size, n * bytes_per_block / (1 << 20),
-                    self.attn_impl)
+        logger.info(
+            "KV pool: %d blocks x %d tokens (%.1f MiB, kv_cache_dtype=%s, "
+            "attn=%s)",
+            n, cfg.block_size, n * bytes_per_block / (1 << 20),
+            cfg.kv_cache_dtype, self.attn_impl,
+        )
         return n
 
     @property
@@ -372,6 +440,23 @@ class ModelRunner:
         return window_mb_bucket(live_blocks, cfg.max_blocks_per_seq)
 
     # --------------------------------------------------------- device helpers
+    def _scale_pool_args(self):
+        """The (kv_k_scale, kv_v_scale) dispatch inputs: the live scale
+        pools when the KV cache is quantized, fresh [1]-shaped donation
+        dummies otherwise (the impls never read them in that mode; same
+        idiom as the fresh-gather window dummies)."""
+        if self.kv_quantized:
+            return self.kv_k_scale, self.kv_v_scale
+        from production_stack_tpu.ops.quantization import SCALE_DTYPE
+
+        return jnp.zeros((1,), SCALE_DTYPE), jnp.zeros((1,), SCALE_DTYPE)
+
+    def _rebind_scale_pools(self, kv_ks, kv_vs) -> None:
+        """Rebind the donated scale pools from a dispatch's outputs
+        (quantized mode only; dummies are dropped)."""
+        if self.kv_quantized:
+            self.kv_k_scale, self.kv_v_scale = kv_ks, kv_vs
+
     def _derive_seeds(self, seed_base, gen0, j):
         """uint32 seed per row for generation index gen0+j; must match
         _token_seed exactly (same wrap-around arithmetic)."""
@@ -381,11 +466,20 @@ class ModelRunner:
         ).astype(jnp.uint32)
 
     # ------------------------------------------------------------------ decode
-    def _decode_impl(self, params, packed, kv_k, kv_v, win_k_in, win_v_in,
-                     counts0, prev_last, *, b: int, mb: int, num_steps: int,
-                     use_cached_window: bool, has_penalties: bool = False,
-                     logprobs_k: int = 0):
+    def _decode_impl(self, params, packed, kv_k, kv_v, kv_ks, kv_vs,
+                     win_k_in, win_v_in, counts0, prev_last, *, b: int,
+                     mb: int, num_steps: int, use_cached_window: bool,
+                     has_penalties: bool = False, logprobs_k: int = 0):
         """One fused K-step decode dispatch.
+
+        kv_ks/kv_vs: the per-(slot, head) dequant scale pools
+        [L, Hkv, num_slots] when the KV cache is quantized (int8 payload
+        pools; ops/quantization.py), donated and returned rebound like the
+        payload pools; [1]-shaped donation dummies otherwise. Each step's
+        fresh KV is quantized ON DEVICE inside the scan — the attention
+        ring (and the persistent window) carry the DEQUANTIZED values, so
+        every later read path (pool gather, window append, Pallas kernel)
+        reconstructs bit-identical keys/values.
 
         packed: int32[b*(NUM_SCALARS+mb)] host buffer laid out as per-row
         scalars (tokens0, pos0, budget, seed_base, gen0, temps, top_k,
@@ -460,22 +554,29 @@ class ModelRunner:
             seed_base[None, :], gen0[None, :], k_iota[:, None]
         )
 
+        quant = self.kv_quantized
         if self.attn_impl == "paged":
             # Decode attends directly against the stacked HBM pool inside
-            # the Pallas kernel — the live KV is never copied. With tp>1
-            # the pool is kv-head-sharded, so the kernel runs under
+            # the Pallas kernel — the live KV is never copied (int8 pools
+            # dequantize IN-KERNEL as rank-1 score/weight scaling). With
+            # tp>1 the pool is kv-head-sharded, so the kernel runs under
             # shard_map over the tp axis (models/llama.py).
             from production_stack_tpu.parallel.mesh import AXIS_TP
 
             tp_mesh = self.mesh if self.mesh.shape[AXIS_TP] > 1 else None
             win_k = win_v = win_len = None
-            paged = (kv_k, kv_v, block_tables, pos0, bs,
+            paged = (kv_k, kv_v, kv_ks if quant else None,
+                     kv_vs if quant else None, block_tables, pos0, bs,
                      self._pallas_interpret, tp_mesh)
         else:
             if use_cached_window:
                 win_k, win_v = win_k_in, win_v_in
             else:
-                win_k, win_v = gather_window(kv_k, kv_v, block_tables, bs)
+                win_k, win_v = gather_window(
+                    kv_k, kv_v, block_tables, bs,
+                    kv_ks if quant else None, kv_vs if quant else None,
+                    out_dtype=self.dtype,
+                )
             win_len = pos0                                       # [b]
             paged = None
 
@@ -483,6 +584,22 @@ class ModelRunner:
         ring_k0 = jnp.zeros((nl, hkv, b, num_steps, dh), self.dtype)
         ring_v0 = jnp.zeros((nl, hkv, b, num_steps, dh), self.dtype)
         ring_pos0 = jnp.full((b, num_steps), _POS_SENTINEL, jnp.int32)
+        if quant:
+            # Quantized-KV sidecar rings: the int8 payload + scales each
+            # step will scatter to the pool at the end of the dispatch.
+            # Quantizing ONCE per token (here, not at the final scatter)
+            # keeps pool contents and the dequantized attention ring /
+            # persistent window derived from the same (q, scale) pair.
+            from production_stack_tpu.ops.quantization import SCALE_DTYPE
+
+            qstate0 = (
+                jnp.zeros((nl, hkv, b, num_steps, dh), jnp.int8),
+                jnp.zeros((nl, hkv, b, num_steps, dh), jnp.int8),
+                jnp.zeros((nl, hkv, b, num_steps), SCALE_DTYPE),
+                jnp.zeros((nl, hkv, b, num_steps), SCALE_DTYPE),
+            )
+        else:
+            qstate0 = ()
         ones = jnp.ones((b,), jnp.int32)
         max_len = cfg.max_model_len
 
@@ -497,7 +614,7 @@ class ModelRunner:
         ).astype(jnp.int32)
 
         def body(carry, j):
-            toks, ring_k, ring_v, ring_pos, counts = carry
+            toks, ring_k, ring_v, ring_pos, counts, qstate = carry
             seeds_j = seed_steps[j]
             positions = jnp.minimum(pos0 + j, max_len - 1)[:, None]
             hidden, k_new, v_new = self._forward(
@@ -505,6 +622,27 @@ class ModelRunner:
                 win_k, win_v, win_len, ring_k, ring_v, ring_pos,
                 paged=paged, lora=lora,
             )
+            if quant:
+                # Quantize this step's fresh KV on device; the attention
+                # ring carries the DEQUANTIZED values so later steps of
+                # this dispatch attend to exactly what later dispatches
+                # will reconstruct from the pool.
+                from production_stack_tpu.ops.quantization import (
+                    dequantize_kv,
+                    quantize_kv,
+                )
+
+                qk, sk = quantize_kv(k_new)
+                qv, sv = quantize_kv(v_new)
+                k_new = dequantize_kv(qk, sk, self.dtype)
+                v_new = dequantize_kv(qv, sv, self.dtype)
+                ring_qk, ring_qv, ring_sk, ring_sv = qstate
+                qstate = (
+                    jax.lax.dynamic_update_slice(ring_qk, qk, (0, 0, 0, j, 0)),
+                    jax.lax.dynamic_update_slice(ring_qv, qv, (0, 0, 0, j, 0)),
+                    jax.lax.dynamic_update_slice(ring_sk, sk, (0, 0, 0, j)),
+                    jax.lax.dynamic_update_slice(ring_sv, sv, (0, 0, 0, j)),
+                )
             logits = self._logits_fn(params, mc, hidden[:, 0])
             if has_penalties:
                 from production_stack_tpu.engine.sampling import (
@@ -541,7 +679,7 @@ class ModelRunner:
             kept = jnp.where(
                 j < budget, nxt.astype(jnp.int32), toks
             )
-            return (kept, ring_k, ring_v, ring_pos, counts), nxt, lp
+            return (kept, ring_k, ring_v, ring_pos, counts, qstate), nxt, lp
 
         def loop_body(state):
             j, carry, toks_all, lp_bufs = state
@@ -555,7 +693,7 @@ class ModelRunner:
                 )
             return j + 1, carry, toks_all, lp_bufs
 
-        carry0 = (tokens0, ring_k0, ring_v0, ring_pos0, counts0)
+        carry0 = (tokens0, ring_k0, ring_v0, ring_pos0, counts0, qstate0)
         if cfg.decode_loop == "scan":
             # A/B alternative: all K steps run unconditionally under
             # lax.scan (more XLA pipelining latitude, no drain-tail skip).
@@ -563,8 +701,8 @@ class ModelRunner:
                 carry, nxt, lp = body(carry, j)
                 return carry, (nxt, lp if logprobs_k else ())
 
-            (final_toks, ring_k, ring_v, _, _), (toks_all, lp_scan) = \
-                jax.lax.scan(
+            (final_toks, ring_k, ring_v, _, _, qstate), (toks_all, lp_scan) \
+                = jax.lax.scan(
                     scan_body, carry0,
                     jnp.arange(num_steps, dtype=jnp.int32),
                 )
@@ -578,8 +716,8 @@ class ModelRunner:
                 jnp.zeros((num_steps, b, logprobs_k), jnp.float32),
                 jnp.zeros((num_steps, b, logprobs_k), jnp.int32),
             ) if logprobs_k else ()
-            _, (final_toks, ring_k, ring_v, _, _), toks_all, lp_bufs = \
-                jax.lax.while_loop(
+            _, (final_toks, ring_k, ring_v, _, _, qstate), toks_all, \
+                lp_bufs = jax.lax.while_loop(
                     lambda st: st[0] < n_active,
                     loop_body,
                     (jnp.int32(0), carry0, toks_buf0, lp_bufs0),
@@ -590,7 +728,9 @@ class ModelRunner:
                 lp_chosen, lp_top, lp_ids = None, None, None
         last_token = jnp.zeros((b_max,), jnp.int32).at[:b].set(final_toks)
 
-        # ONE scatter writes the whole dispatch's KV back to the paged pool.
+        # ONE scatter writes the whole dispatch's KV back to the paged pool
+        # (quantized mode: the int8 payload + per-slot scales the scan
+        # recorded; the pool never holds compute-dtype KV).
         flat_slots = slot_steps.reshape(-1)                       # [K*b]
         k_flat = ring_k.transpose(0, 1, 3, 2, 4).reshape(
             nl, hkv, num_steps * b, dh
@@ -598,12 +738,33 @@ class ModelRunner:
         v_flat = ring_v.transpose(0, 1, 3, 2, 4).reshape(
             nl, hkv, num_steps * b, dh
         )
-        kv_k = kv_k.at[:, :, flat_slots].set(k_flat)
-        kv_v = kv_v.at[:, :, flat_slots].set(v_flat)
+        if quant:
+            ring_qk, ring_qv, ring_sk, ring_sv = qstate
+            kv_k = kv_k.at[:, :, flat_slots].set(
+                ring_qk.transpose(0, 1, 3, 2, 4).reshape(
+                    nl, hkv, num_steps * b, dh
+                )
+            )
+            kv_v = kv_v.at[:, :, flat_slots].set(
+                ring_qv.transpose(0, 1, 3, 2, 4).reshape(
+                    nl, hkv, num_steps * b, dh
+                )
+            )
+            kv_ks = kv_ks.at[:, :, flat_slots].set(
+                ring_sk.transpose(0, 1, 3, 2).reshape(nl, hkv, num_steps * b)
+            )
+            kv_vs = kv_vs.at[:, :, flat_slots].set(
+                ring_sv.transpose(0, 1, 3, 2).reshape(nl, hkv, num_steps * b)
+            )
+        else:
+            kv_k = kv_k.at[:, :, flat_slots].set(k_flat)
+            kv_v = kv_v.at[:, :, flat_slots].set(v_flat)
         if self.attn_impl != "paged":
             # Append the dispatch's KV into the persistent window too (slot
             # s = absolute position s), so the next dispatch over the same
-            # rows skips the full re-gather. Out-of-budget steps drop.
+            # rows skips the full re-gather. Out-of-budget steps drop. The
+            # quantized path appends the DEQUANTIZED values — identical to
+            # what a fresh pool gather would reconstruct.
             s_tot = mb * bs
             iota_b = jnp.arange(b, dtype=jnp.int32)[None, :]      # [1, b]
             widx = jnp.where(valid, iota_b * s_tot + p, b * s_tot)
@@ -613,9 +774,9 @@ class ModelRunner:
             win_v = win_v.reshape(nl, hkv, b * s_tot, dh).at[
                 :, :, widx.reshape(-1)
             ].set(v_flat, mode="drop").reshape(nl, hkv, b, s_tot, dh)
-            return (toks_all, kv_k, kv_v, win_k, win_v,
+            return (toks_all, kv_k, kv_v, kv_ks, kv_vs, win_k, win_v,
                     lp_chosen, lp_top, lp_ids, last_token)        # [K, b]
-        return (toks_all, kv_k, kv_v, win_k_in, win_v_in,
+        return (toks_all, kv_k, kv_v, kv_ks, kv_vs, win_k_in, win_v_in,
                 lp_chosen, lp_top, lp_ids, last_token)
 
     def _issue_decode(self, batch: ScheduledBatch) -> "DispatchHandle":
@@ -731,13 +892,17 @@ class ModelRunner:
         prev_last = (
             chain_entry["last"] if chain_entry is not None else self._zero_last
         )
-        (toks_all, self.kv_k, self.kv_v, wk2, wv2, lp_c, lp_t, lp_i,
-         last_token) = self._decode(
+        kv_ks, kv_vs = self._scale_pool_args()
+        (toks_all, self.kv_k, self.kv_v, kv_ks2, kv_vs2, wk2, wv2, lp_c,
+         lp_t, lp_i, last_token) = self._decode(
             self.params, jnp.asarray(packed), self.kv_k, self.kv_v,
-            wk, wv, jnp.asarray(counts), prev_last,
+            kv_ks, kv_vs, wk, wv, jnp.asarray(counts), prev_last,
             b=b, mb=mb, num_steps=k, use_cached_window=use_cached,
             has_penalties=has_penalties, logprobs_k=logprobs_k,
         )
+        self._rebind_scale_pools(kv_ks2, kv_vs2)
+        if self.kv_quantized:
+            self.kv_quant_tokens_written += sum(batch.decode_steps)
         if self.attn_impl != "paged":
             self._win_cache = {
                 "ids": ids, "b": b, "mb": mb,
@@ -792,10 +957,17 @@ class ModelRunner:
         return out
 
     # ----------------------------------------------------------------- prefill
-    def _prefill_impl(self, params, packed, kv_k, kv_v, counts0, *, b: int,
-                      t: int, mb: int, has_window: bool, b_max: int,
-                      has_penalties: bool = False, logprobs_k: int = 0):
+    def _prefill_impl(self, params, packed, kv_k, kv_v, kv_ks, kv_vs,
+                      counts0, *, b: int, t: int, mb: int, has_window: bool,
+                      b_max: int, has_penalties: bool = False,
+                      logprobs_k: int = 0):
         """One (multi-sequence) prefill chunk dispatch.
+
+        kv_ks/kv_vs: per-(slot, head) dequant scale pools when the KV cache
+        is quantized (donated + returned rebound, like _decode_impl); the
+        chunk's fresh KV is quantized on device at the end of the dispatch
+        — no extra host round-trip — and the history window gather
+        dequantizes inline.
 
         packed: int32[b*(NUM_SCALARS+mb) + b*t]: per-row scalars
         (chunk_start, chunk_len, seed_base, gen0, temps, top_k, top_p, pad,
@@ -836,8 +1008,13 @@ class ModelRunner:
         blk = jnp.take_along_axis(block_tables, blk_idx, axis=1)
         slot_mapping = jnp.where(in_chunk, blk * bs + positions % bs, 0)
 
+        quant = self.kv_quantized
         if has_window:
-            win_k, win_v = gather_window(kv_k, kv_v, block_tables, bs)
+            win_k, win_v = gather_window(
+                kv_k, kv_v, block_tables, bs,
+                kv_ks if quant else None, kv_vs if quant else None,
+                out_dtype=self.dtype,
+            )
             win_len = chunk_start
         else:
             win_k = win_v = win_len = None
@@ -884,15 +1061,30 @@ class ModelRunner:
 
         nl, hkv, dh = mc.num_layers, mc.num_kv_heads, mc.head_dim_
         flat_slots = slot_mapping.reshape(-1)                     # [b*t]
-        kv_k = kv_k.at[:, :, flat_slots].set(k_new.reshape(nl, hkv, b * t, dh))
-        kv_v = kv_v.at[:, :, flat_slots].set(v_new.reshape(nl, hkv, b * t, dh))
+        k_flat = k_new.reshape(nl, hkv, b * t, dh)
+        v_flat = v_new.reshape(nl, hkv, b * t, dh)
+        if quant:
+            # Quantize the chunk's KV on device before the single scatter
+            # — compute-dtype KV never lands in the pool.
+            from production_stack_tpu.ops.quantization import quantize_kv
+
+            kq, ks = quantize_kv(k_flat)
+            vq, vs = quantize_kv(v_flat)
+            kv_k = kv_k.at[:, :, flat_slots].set(kq)
+            kv_v = kv_v.at[:, :, flat_slots].set(vq)
+            kv_ks = kv_ks.at[:, :, flat_slots].set(ks)
+            kv_vs = kv_vs.at[:, :, flat_slots].set(vs)
+        else:
+            kv_k = kv_k.at[:, :, flat_slots].set(k_flat)
+            kv_v = kv_v.at[:, :, flat_slots].set(v_flat)
         # Device-resident last-token vector (final rows' sampled tokens):
         # the first decode dispatch after this prefill may chain from it
         # without a host roundtrip (see _decode_impl).
         last_token = jnp.zeros((b_max,), jnp.int32).at[:b].set(
             next_tokens.astype(jnp.int32)
         )
-        return next_tokens, kv_k, kv_v, lp[0], lp[1], lp[2], last_token
+        return (next_tokens, kv_k, kv_v, kv_ks, kv_vs, lp[0], lp[1], lp[2],
+                last_token)
 
     def _issue_prefill(self, batch: ScheduledBatch) -> "DispatchHandle":
         cfg = self.config
@@ -964,13 +1156,17 @@ class ModelRunner:
         else:
             counts = np.zeros((1, 1), np.int32)
 
-        next_tokens, self.kv_k, self.kv_v, lp_c, lp_t, lp_i, last_token = \
-            self._prefill(
-                self.params, jnp.asarray(packed), self.kv_k, self.kv_v,
-                jnp.asarray(counts),
-                b=b, t=t, mb=mb, has_window=has_window, b_max=self._b_max,
-                has_penalties=has_penalties, logprobs_k=logprobs_k,
-            )
+        kv_ks, kv_vs = self._scale_pool_args()
+        (next_tokens, self.kv_k, self.kv_v, kv_ks2, kv_vs2, lp_c, lp_t,
+         lp_i, last_token) = self._prefill(
+            self.params, jnp.asarray(packed), self.kv_k, self.kv_v,
+            kv_ks, kv_vs, jnp.asarray(counts),
+            b=b, t=t, mb=mb, has_window=has_window, b_max=self._b_max,
+            has_penalties=has_penalties, logprobs_k=logprobs_k,
+        )
+        self._rebind_scale_pools(kv_ks2, kv_vs2)
+        if self.kv_quantized:
+            self.kv_quant_tokens_written += sum(batch.chunk_lens)
         # Final rows' sampled tokens are chainable by the next decode
         # dispatch without a host roundtrip. Non-final chunks produce no
         # tokens — no entry, so they never evict a live decode chain.
@@ -1104,6 +1300,17 @@ class ModelRunner:
         return jax.jit(gather)
 
     @functools.cached_property
+    def _gather_scales_jit(self):
+        bs = self.config.block_size
+
+        def gather(kv_ks, kv_vs, blocks):
+            nl, hkv, ns = kv_ks.shape
+            kr = kv_ks.reshape(nl, hkv, ns // bs, bs)
+            vr = kv_vs.reshape(nl, hkv, ns // bs, bs)
+            return kr[:, :, blocks], vr[:, :, blocks]    # [L, Hkv, n, bs]
+        return jax.jit(gather)
+
+    @functools.cached_property
     def _scatter_blocks_jit(self):
         bs = self.config.block_size
 
@@ -1116,12 +1323,29 @@ class ModelRunner:
             return kr.reshape(nl, hkv, ns, dh), vr.reshape(nl, hkv, ns, dh)
         return jax.jit(scatter, donate_argnums=(0, 1))
 
+    @functools.cached_property
+    def _scatter_scales_jit(self):
+        bs = self.config.block_size
+
+        def scatter(kv_ks, kv_vs, blocks, ks_new, vs_new):
+            nl, hkv, ns = kv_ks.shape
+            kr = kv_ks.reshape(nl, hkv, ns // bs, bs)
+            vr = kv_vs.reshape(nl, hkv, ns // bs, bs)
+            kr = kr.at[:, :, blocks].set(ks_new.astype(kv_ks.dtype))
+            vr = vr.at[:, :, blocks].set(vs_new.astype(kv_vs.dtype))
+            return kr.reshape(nl, hkv, ns), vr.reshape(nl, hkv, ns)
+        return jax.jit(scatter, donate_argnums=(0, 1))
+
     def read_blocks(self, block_ids: List[int]):
         """Device->host read of whole KV blocks.
 
-        Returns (k, v) numpy arrays [n, L, Hkv, bs, Dh]. May raise
-        RuntimeError if a concurrent step donated the pool buffers mid-read
-        (the offload spiller retries against the rebound arrays).
+        Returns (k, v, k_scale, v_scale) numpy arrays: payload
+        [n, L, Hkv, bs, Dh] in the pool's storage dtype, plus per-slot
+        scales [n, L, Hkv, bs] when the KV cache is quantized (None
+        otherwise) — offloaded/handed-off blocks stay int8 on the wire.
+        May raise RuntimeError if a concurrent step donated the pool
+        buffers mid-read (the offload spiller retries against the rebound
+        arrays).
         """
         n = len(block_ids)
         nb = _bucket(n, 1, max(1, self.num_kv_blocks))
@@ -1132,7 +1356,14 @@ class ModelRunner:
         )
         k_np = np.asarray(k_g).transpose(2, 0, 1, 3, 4)[:n]  # [n,L,Hkv,bs,Dh]
         v_np = np.asarray(v_g).transpose(2, 0, 1, 3, 4)[:n]
-        return k_np, v_np
+        if not self.kv_quantized:
+            return k_np, v_np, None, None
+        ks_g, vs_g = self._gather_scales_jit(
+            self.kv_k_scale, self.kv_v_scale, jnp.asarray(blocks)
+        )
+        ks_np = np.asarray(ks_g).transpose(2, 0, 1, 3)[:n]   # [n,L,Hkv,bs]
+        vs_np = np.asarray(vs_g).transpose(2, 0, 1, 3)[:n]
+        return k_np, v_np, ks_np, vs_np
 
     def read_blocks_retry(self, block_ids: List[int], attempts: int = 3):
         """read_blocks with retry against donation races: an engine step may
@@ -1148,12 +1379,22 @@ class ModelRunner:
                     raise
                 time.sleep(0.01)
 
-    def write_blocks(self, block_ids: List[int], k_np, v_np) -> None:
+    def write_blocks(self, block_ids: List[int], k_np, v_np,
+                     k_scale=None, v_scale=None) -> None:
         """Host->device restore of whole KV blocks.
 
-        k_np/v_np: [n, L, Hkv, bs, Dh]. Runs on the engine loop between
-        steps, so the donated update is ordered with model dispatches.
+        k_np/v_np: [n, L, Hkv, bs, Dh] in the pool's storage dtype;
+        quantized pools additionally require the per-slot scales
+        [n, L, Hkv, bs] (an offloaded/handed-off int8 block restores
+        bit-identically — no requantization). Runs on the engine loop
+        between steps, so the donated update is ordered with model
+        dispatches.
         """
+        if self.kv_quantized and k_scale is None:
+            raise ValueError(
+                "restoring into an int8 KV pool requires per-slot scales "
+                "(blob written by a kv_cache_dtype=bfloat16 engine?)"
+            )
         n = len(block_ids)
         nb = _bucket(n, 1, max(1, self.num_kv_blocks))
         if nb != n:
@@ -1169,6 +1410,18 @@ class ModelRunner:
             self.kv_k, self.kv_v, jnp.asarray(blocks), jnp.asarray(k_blk),
             jnp.asarray(v_blk),
         )
+        if self.kv_quantized:
+            if nb != n:
+                spad = np.zeros((nb - n,) + k_scale.shape[1:], k_scale.dtype)
+                k_scale = np.concatenate([k_scale, spad])
+                v_scale = np.concatenate([v_scale, spad])
+            ks_blk = k_scale.transpose(1, 2, 0, 3)   # [L, Hkv, nb, bs]
+            vs_blk = v_scale.transpose(1, 2, 0, 3)
+            self.kv_k_scale, self.kv_v_scale = self._scatter_scales_jit(
+                self.kv_k_scale, self.kv_v_scale, jnp.asarray(blocks),
+                jnp.asarray(ks_blk), jnp.asarray(vs_blk),
+            )
+            self.kv_quant_tokens_written += n * self.config.block_size
         self._win_cache = None  # pool changed outside a decode dispatch
 
     # ------------------------------------------------------------- maintenance
@@ -1312,20 +1565,22 @@ class ModelRunner:
                     counts = jnp.zeros(
                         (db, mc.vocab_size) if pen else (1, 1), jnp.int32
                     )
+                    kv_ks, kv_vs = self._scale_pool_args()
                     out = self._decode(
                         self.params,
                         jnp.zeros((NUM_SCALARS * db + db * mb,), jnp.int32),
-                        self.kv_k, self.kv_v, wk, wv, counts,
+                        self.kv_k, self.kv_v, kv_ks, kv_vs, wk, wv, counts,
                         self._zero_last,
                         b=db, mb=mb, num_steps=dk,
                         use_cached_window=cached,
                         has_penalties=pen, logprobs_k=lpk,
                     )
                     _, self.kv_k, self.kv_v = out[0], out[1], out[2]
+                    self._rebind_scale_pools(out[3], out[4])
                     if self.attn_impl != "paged":
                         # Both variants return the (appended/gathered)
                         # windows; the inputs were donated, so rebind.
-                        wins[(db, mb)] = (out[3], out[4])
+                        wins[(db, mb)] = (out[5], out[6])
                     n_warmed += 1
             t_floor = prefill_t_floor(cfg.max_num_batched_tokens)
             for pb, t, mb, has_window in self.reachable_prefill_families():
@@ -1347,17 +1602,19 @@ class ModelRunner:
                     counts = jnp.zeros(
                         (pb, mc.vocab_size) if pen else (1, 1), jnp.int32
                     )
+                    kv_ks, kv_vs = self._scale_pool_args()
                     out = self._prefill(
                         self.params,
                         jnp.zeros(
                             (NUM_SCALARS * pb + pb * mb + pb * t,), jnp.int32
                         ),
-                        self.kv_k, self.kv_v, counts,
+                        self.kv_k, self.kv_v, kv_ks, kv_vs, counts,
                         b=pb, t=t, mb=mb, has_window=has_window,
                         b_max=self._b_max,
                         has_penalties=pen, logprobs_k=lpk,
                     )
                     self.kv_k, self.kv_v = out[1], out[2]
+                    self._rebind_scale_pools(out[3], out[4])
                     n_warmed += 1
             # Warmup dispatches block-wait on the last output so compile
             # failures surface here, not mid-serving.
@@ -1376,22 +1633,13 @@ class ModelRunner:
             # loses nothing.
             try:
                 deleted = self.kv_k.is_deleted() or self.kv_v.is_deleted()
+                if self.kv_quantized and not deleted:
+                    deleted = (self.kv_k_scale.is_deleted()
+                               or self.kv_v_scale.is_deleted())
             except Exception:  # noqa: BLE001 — treat unprobeable as gone
                 deleted = True
             if deleted:
-                from production_stack_tpu.parallel import kv_pool_sharding
-
                 logger.warning(
                     "Rebuilding KV pool consumed by failed warmup"
                 )
-                kv_sh = kv_pool_sharding(self.model_config, self.mesh)
-                shape = (
-                    self.model_config.num_layers,
-                    self.model_config.num_kv_heads,
-                    self.num_kv_blocks * self.config.block_size,
-                    self.model_config.head_dim_,
-                )
-                self.kv_k = jax.device_put(jnp.zeros(shape, self.dtype),
-                                           kv_sh)
-                self.kv_v = jax.device_put(jnp.zeros(shape, self.dtype),
-                                           kv_sh)
+                self._alloc_kv_pools()

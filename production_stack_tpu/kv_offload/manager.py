@@ -53,6 +53,14 @@ class KVOffloadManager:
 
             self.remote = RemoteKVClient(remote_url)
         self.pack, self.unpack = get_serde(serde)
+        # Store keys are namespaced by the KV-cache storage dtype: int8 and
+        # bf16 engines sharing one offload tier must never splice each
+        # other's blocks (the dequantized values differ from what the
+        # other engine computed — a silent greedy-determinism break).
+        # bfloat16 keeps the bare hash so pre-quantization stores stay
+        # readable.
+        self._kv_quantized = bool(getattr(runner, "kv_quantized", False))
+        self._key_prefix = b"q8|" if self._kv_quantized else b""
         self.flush_interval = flush_interval
         self.spill_batch = spill_batch
 
@@ -73,11 +81,15 @@ class KVOffloadManager:
         return self.host_pool is not None or self.remote is not None
 
     # -------------------------------------------------------------- write path
+    def _store_key(self, h: bytes) -> bytes:
+        return self._key_prefix + h
+
     def on_block_registered(self, h: bytes, blk: int) -> None:
         """Engine-loop hook: a block just became full + content-addressed."""
         if not self.enabled or not h:
             return
-        if self.host_pool is not None and self.host_pool.contains(h):
+        if self.host_pool is not None and \
+                self.host_pool.contains(self._store_key(h)):
             return
         with self._lock:
             if h in self._queued_hashes:
@@ -119,34 +131,40 @@ class KVOffloadManager:
         blks = [blk for _, blk in live]
         # Donation-race retry lives in the runner (shared with the disagg
         # handoff publisher).
-        k_np, v_np = self.runner.read_blocks_retry(blks)
+        k_np, v_np, ks_np, vs_np = self.runner.read_blocks_retry(blks)
         for i, (h, blk) in enumerate(live):
             if self.block_manager.hash_of_block(blk) != h:
                 continue  # recycled during the read; data is unreliable
-            blob = self.pack(k_np[i], v_np[i])
+            blob = self.pack(
+                k_np[i], v_np[i],
+                None if ks_np is None else ks_np[i],
+                None if vs_np is None else vs_np[i],
+            )
+            key = self._store_key(h)
             if self.host_pool is not None:
-                self.host_pool.put(h, blob)
+                self.host_pool.put(key, blob)
             if self.remote is not None:
                 try:
-                    self.remote.put(h, blob)
+                    self.remote.put(key, blob)
                 except ConnectionError as e:
                     logger.warning("Remote KV put failed: %s", e)
             self.spilled_blocks_total += 1
 
     # --------------------------------------------------------------- read path
     def _fetch(self, h: bytes) -> Optional[bytes]:
+        key = self._store_key(h)
         if self.host_pool is not None:
-            blob = self.host_pool.get(h)
+            blob = self.host_pool.get(key)
             if blob is not None:
                 return blob
         if self.remote is not None:
             try:
-                blob = self.remote.get(h)
+                blob = self.remote.get(key)
             except ConnectionError as e:
                 logger.warning("Remote KV get failed: %s", e)
                 return None
             if blob is not None and self.host_pool is not None:
-                self.host_pool.put(h, blob)  # promote to the local tier
+                self.host_pool.put(key, blob)  # promote to the local tier
             return blob
         return None
 
@@ -180,21 +198,33 @@ class KVOffloadManager:
         # At least one token must remain for prefill to compute logits from.
         max_full = (len(token_ids) - 1) // bs
         start_blk = num_computed_tokens // bs
-        hits: List[Tuple[int, np.ndarray, np.ndarray]] = []
+        hits: List[Tuple[int, tuple]] = []
         for i in range(start_blk, max_full):
             h = _block_hash(prev, token_ids[i * bs:(i + 1) * bs])
             blob = self._fetch(h)
             if blob is None:
                 break
-            k, v = self.unpack(blob)
-            hits.append((block_ids[i], k, v))
+            k, v, ks, vs = self.unpack(blob)
+            if (ks is not None) != self._kv_quantized:
+                # Wire/pool dtype mismatch (store written under another
+                # kv_cache_dtype, possible despite key namespacing via a
+                # hand-migrated store): treat as a miss, never splice.
+                break
+            hits.append((block_ids[i], (k, v, ks, vs)))
             prev = h
         if not hits:
             return 0
-        blks = [b for b, _, _ in hits]
-        k_np = np.stack([k for _, k, _ in hits])
-        v_np = np.stack([v for _, _, v in hits])
-        self.runner.write_blocks(blks, k_np, v_np)
+        blks = [b for b, _ in hits]
+        k_np = np.stack([d[0] for _, d in hits])
+        v_np = np.stack([d[1] for _, d in hits])
+        if self._kv_quantized:
+            self.runner.write_blocks(
+                blks, k_np, v_np,
+                np.stack([d[2] for _, d in hits]),
+                np.stack([d[3] for _, d in hits]),
+            )
+        else:
+            self.runner.write_blocks(blks, k_np, v_np)
         restored = len(hits) * bs
         self.restored_tokens_total += restored
         # Offload hits count toward the prefix-cache telemetry the router's

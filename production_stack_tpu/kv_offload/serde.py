@@ -4,16 +4,30 @@
 same spirit as the reference's ``serde: "naive"`` LMCache option (reference
 tutorials/assets/values-06-shared-storage.yaml). One value packs a block's K
 and V: two arrays of shape [L, Hkv, block_size, Dh].
+
+Two wire versions, distinguished by the magic (the header is the version
+tag, so a store holding blobs from both generations keeps decoding):
+
+  * ``PKV1`` — payload only (bf16/f16/f32 pools): header + K + V bytes.
+    Unchanged from the original format, so pre-quantization stores decode.
+  * ``PKV2`` — quantized pools (--kv-cache-dtype int8): header additionally
+    names the scale dtype, and the K/V int8 payload is followed by the
+    per-(slot, head) scale planes [L, Hkv, block_size]. Blocks stay int8 on
+    the wire — an offload/handoff round-trip moves ~half the bytes of bf16
+    and restores bit-identically (no requantization).
 """
 
 import struct
-from typing import Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 
 _MAGIC = b"PKV1"
-_DTYPES = {0: "bfloat16", 1: "float32", 2: "float16"}
+_MAGIC_Q = b"PKV2"
+_DTYPES = {0: "bfloat16", 1: "float32", 2: "float16", 3: "int8"}
 _DTYPE_IDS = {v: k for k, v in _DTYPES.items()}
+_HDR = "<4sB4I"
+_HDR_Q = "<4sBB4I"
 
 
 def _np_dtype(name: str):
@@ -24,29 +38,67 @@ def _np_dtype(name: str):
     return np.dtype(name)
 
 
-def pack_block(k: np.ndarray, v: np.ndarray) -> bytes:
-    """k/v: [L, Hkv, bs, Dh] arrays (any supported dtype)."""
+def pack_block(
+    k: np.ndarray, v: np.ndarray,
+    k_scale: Optional[np.ndarray] = None,
+    v_scale: Optional[np.ndarray] = None,
+) -> bytes:
+    """k/v: [L, Hkv, bs, Dh] arrays; k_scale/v_scale: [L, Hkv, bs] per-slot
+    dequant scales (int8 pools) — their presence selects the PKV2 wire
+    version."""
     name = {"bfloat16": "bfloat16"}.get(str(k.dtype), str(k.dtype))
+    if k_scale is None:
+        header = struct.pack(
+            _HDR, _MAGIC, _DTYPE_IDS[name],
+            k.shape[0], k.shape[1], k.shape[2], k.shape[3],
+        )
+        return header + k.tobytes() + v.tobytes()
+    sname = {"bfloat16": "bfloat16"}.get(
+        str(k_scale.dtype), str(k_scale.dtype)
+    )
     header = struct.pack(
-        "<4sB4I", _MAGIC, _DTYPE_IDS[name],
+        _HDR_Q, _MAGIC_Q, _DTYPE_IDS[name], _DTYPE_IDS[sname],
         k.shape[0], k.shape[1], k.shape[2], k.shape[3],
     )
-    return header + k.tobytes() + v.tobytes()
+    return (header + k.tobytes() + v.tobytes()
+            + k_scale.tobytes() + v_scale.tobytes())
 
 
-def unpack_block(blob: bytes) -> Tuple[np.ndarray, np.ndarray]:
-    magic, dt, nl, hkv, bs, dh = struct.unpack_from("<4sB4I", blob)
-    if magic != _MAGIC:
+def unpack_block(
+    blob: bytes,
+) -> Tuple[np.ndarray, np.ndarray,
+           Optional[np.ndarray], Optional[np.ndarray]]:
+    """-> (k, v, k_scale, v_scale); the scales are None for PKV1 blobs
+    (unquantized pools / pre-quantization stores)."""
+    magic = blob[:4]
+    if magic == _MAGIC:
+        _, dt, nl, hkv, bs, dh = struct.unpack_from(_HDR, blob)
+        off = struct.calcsize(_HDR)
+        sdt = None
+    elif magic == _MAGIC_Q:
+        _, dt, sdt, nl, hkv, bs, dh = struct.unpack_from(_HDR_Q, blob)
+        off = struct.calcsize(_HDR_Q)
+    else:
         raise ValueError("bad KV block magic")
     dtype = _np_dtype(_DTYPES[dt])
-    off = struct.calcsize("<4sB4I")
     n = nl * hkv * bs * dh
     nbytes = n * dtype.itemsize
     k = np.frombuffer(blob, dtype, count=n, offset=off).reshape(nl, hkv, bs, dh)
     v = np.frombuffer(blob, dtype, count=n, offset=off + nbytes).reshape(
         nl, hkv, bs, dh
     )
-    return k, v
+    if sdt is None:
+        return k, v, None, None
+    sdtype = _np_dtype(_DTYPES[sdt])
+    ns = nl * hkv * bs
+    soff = off + 2 * nbytes
+    k_scale = np.frombuffer(blob, sdtype, count=ns, offset=soff).reshape(
+        nl, hkv, bs
+    )
+    v_scale = np.frombuffer(
+        blob, sdtype, count=ns, offset=soff + ns * sdtype.itemsize
+    ).reshape(nl, hkv, bs)
+    return k, v, k_scale, v_scale
 
 
 def get_serde(name: str):

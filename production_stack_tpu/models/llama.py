@@ -99,9 +99,9 @@ def _layer_body(
     chunk_lens: jax.Array,
     win_k, win_v, win_len,
     ring_k, ring_v, ring_pos,
-    paged=None,               # (pool_k, pool_v, block_tables, kv_lens,
-    layer_idx=None,           #  block_size, interpret, tp_mesh|None)
-                              #  + scan layer index
+    paged=None,               # (pool_k, pool_v, k_scale|None, v_scale|None,
+    layer_idx=None,           #  block_tables, kv_lens, block_size,
+                              #  interpret, tp_mesh|None) + scan layer index
     lora=None,                # (adapter_idx [B], {target: (A, B)} ONE layer)
     ring_mesh=None,           # Mesh with sp>1: first-chunk prefill rings
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
@@ -180,8 +180,8 @@ def _layer_body(
             paged_flash_decode_stats_tp,
         )
 
-        (pool_k, pool_v, block_tables, kv_lens, block_size, interpret,
-         tp_mesh) = paged
+        (pool_k, pool_v, pool_ks, pool_vs, block_tables, kv_lens,
+         block_size, interpret, tp_mesh) = paged
         q2 = q.reshape(b, h, dh)
         if tp_mesh is not None:
             # TP>1: the pool is kv-head-sharded; run the kernel per-shard
@@ -190,11 +190,13 @@ def _layer_body(
             out_p, m_p, l_p = paged_flash_decode_stats_tp(
                 q2, pool_k, pool_v, block_tables, kv_lens, layer_idx,
                 tp_mesh, block_size=block_size, interpret=interpret,
+                k_scale=pool_ks, v_scale=pool_vs,
             )
         else:
             out_p, m_p, l_p = paged_flash_decode_stats(
                 q2, pool_k, pool_v, block_tables, kv_lens, layer_idx,
                 block_size=block_size, interpret=interpret,
+                k_scale=pool_ks, v_scale=pool_vs,
             )
         kc = k.transpose(2, 0, 1, 3)          # [Hkv, B, 1, Dh] current token
         vc = v.transpose(2, 0, 1, 3)
@@ -238,9 +240,11 @@ def forward(
     ring_pos: Optional[jax.Array] = None,  # [B, R]
     *,
     act_sharding=None,
-    paged=None,  # (pool_k [L,Hkv,S,Dh], pool_v, block_tables [B,Mb],
-                 #  kv_lens [B], block_size, interpret, tp_mesh|None)
-                 #  — paged decode path (tp_mesh set => shard_map over tp)
+    paged=None,  # (pool_k [L,Hkv,S,Dh], pool_v, k_scale [L,Hkv,S]|None,
+                 #  v_scale|None, block_tables [B,Mb], kv_lens [B],
+                 #  block_size, interpret, tp_mesh|None) — paged decode
+                 #  path (tp_mesh set => shard_map over tp; scales set =>
+                 #  int8 pools, in-kernel dequantization)
     lora=None,   # (adapter_idx [B], {target: (A [L,Na,in,r], B [L,Na,r,out])})
     ring_mesh=None,  # Mesh with sp>1: first-chunk prefill uses ring attention
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:

@@ -233,6 +233,9 @@ def gather_window(
     kv_v: jax.Array,
     block_tables: jax.Array,  # [B, Mb] int32
     block_size: int,
+    k_scale: Optional[jax.Array] = None,  # [L, Hkv, num_slots] (int8 pools)
+    v_scale: Optional[jax.Array] = None,
+    out_dtype=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """One gather per dispatch: paged pool -> contiguous per-sequence windows
     [L, Hkv, B, Mb*bs, Dh]. Amortized over every layer and every fused decode
@@ -244,7 +247,13 @@ def gather_window(
     (16x fewer indices, 16x longer runs), which XLA lowers to block-sized
     copies instead of row-sized ones — the slot-indexed form measured only
     ~2 GB/s on a v5e (r3 profiling), making the gather the prefill
-    bottleneck."""
+    bottleneck.
+
+    Quantized pools (``k_scale``/``v_scale`` set): the gather reads int8
+    payload + per-slot scales (half the pool-side traffic of bf16) and the
+    window is dequantized to ``out_dtype`` on the way out, so attention math
+    downstream is unchanged and every read path reconstructs the same
+    values (ops/quantization.py:dequantize_kv)."""
     b, mb = block_tables.shape
     l, hkv, num_slots, dh = kv_k.shape
     nb = num_slots // block_size
@@ -252,10 +261,21 @@ def gather_window(
     vr = kv_v.reshape(l, hkv, nb, block_size, dh)
     win_k = kr[:, :, block_tables]  # [L, Hkv, B, Mb, bs, Dh]
     win_v = vr[:, :, block_tables]
-    return (
-        win_k.reshape(l, hkv, b, mb * block_size, dh),
-        win_v.reshape(l, hkv, b, mb * block_size, dh),
-    )
+    win_k = win_k.reshape(l, hkv, b, mb * block_size, dh)
+    win_v = win_v.reshape(l, hkv, b, mb * block_size, dh)
+    if k_scale is not None:
+        from production_stack_tpu.ops.quantization import dequantize_kv
+
+        out_dtype = out_dtype or jnp.bfloat16
+        ks = k_scale.reshape(l, hkv, nb, block_size)[:, :, block_tables]
+        vs = v_scale.reshape(l, hkv, nb, block_size)[:, :, block_tables]
+        win_k = dequantize_kv(
+            win_k, ks.reshape(l, hkv, b, mb * block_size), out_dtype
+        )
+        win_v = dequantize_kv(
+            win_v, vs.reshape(l, hkv, b, mb * block_size), out_dtype
+        )
+    return win_k, win_v
 
 
 def gather_kv_pages(pool: jax.Array, block_tables: jax.Array, block_size: int) -> jax.Array:
@@ -283,11 +303,16 @@ def paged_attention_xla(
     *,
     block_size: int,
     scale: Optional[float] = None,
+    k_scale: Optional[jax.Array] = None,  # [Hkv, num_slots] (int8 pools)
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Reference paged attention: gather pages, masked softmax attention.
 
     Causal semantics: query at position p attends to KV slots [0, p] of its own
     sequence; slots beyond kv_len are masked (they may alias the null block).
+    Int8 pools pass per-slot scales (``k_scale``/``v_scale``); the gathered
+    pages dequantize inline before the score/PV contractions — the quantized
+    pool never materializes as a bf16 copy of itself.
     """
     b, t, h, dh = q.shape
     hkv = k_pool.shape[0]
@@ -297,6 +322,17 @@ def paged_attention_xla(
 
     k = gather_kv_pages(k_pool, block_tables, block_size)  # [Hkv, B, S, Dh]
     v = gather_kv_pages(v_pool, block_tables, block_size)
+    if k_scale is not None:
+        from production_stack_tpu.ops.quantization import dequantize_kv
+
+        ks = gather_kv_pages(
+            k_scale[..., None], block_tables, block_size
+        )[..., 0]                                           # [Hkv, B, S]
+        vs = gather_kv_pages(
+            v_scale[..., None], block_tables, block_size
+        )[..., 0]
+        k = dequantize_kv(k, ks, jnp.float32)
+        v = dequantize_kv(v, vs, jnp.float32)
     s = k.shape[2]
 
     qg = q.reshape(b, t, hkv, g, dh).astype(jnp.float32) * scale
@@ -317,6 +353,7 @@ def paged_attention_xla(
 def paged_attention(
     q, k_pool, v_pool, block_tables, kv_lens, q_positions,
     *, block_size: int, scale: Optional[float] = None, impl: str = "xla",
+    k_scale=None, v_scale=None,
 ) -> jax.Array:
     if impl == "pallas":
         try:
@@ -333,10 +370,12 @@ def paged_attention(
             return paged_attention_pallas(
                 q, k_pool, v_pool, block_tables, kv_lens, q_positions,
                 block_size=block_size, scale=scale,
+                k_scale=k_scale, v_scale=v_scale,
             )
     return paged_attention_xla(
         q, k_pool, v_pool, block_tables, kv_lens, q_positions,
         block_size=block_size, scale=scale,
+        k_scale=k_scale, v_scale=v_scale,
     )
 
 

@@ -63,21 +63,27 @@ def _decode_kernel(
     q_ref,              # VMEM [1, H, Dh]
     k_hbm,              # HBM  [L, Hkv, num_slots/PACK, Dh*PACK]
     v_hbm,              # HBM  [L, Hkv, num_slots/PACK, Dh*PACK]
-    # outputs
-    o_ref,              # VMEM [1, H, Dh]
-    m_ref,              # VMEM [1, 1, H] f32 — running max (pre-normalization)
-    l_ref,              # VMEM [1, 1, H] f32 — softmax denominator
-    # scratch
-    k_buf,              # VMEM [NUM_BUFS, Hkv, SUPER_TOKENS/PACK, Dh*PACK]
-    v_buf,
-    sem_k,              # DMA sems (NUM_BUFS, pages_per_super)
-    sem_v,
-    *,
+    # quantized==True only (int8 pools): this dispatch's pre-gathered
+    # per-slot dequant scales, lane-half-major (see
+    # paged_flash_decode_stats) — k_sc_ref/v_sc_ref VMEM
+    # [1, PACK, Hkv, Mb*bs/PACK] f32, then the outputs/scratch below.
+    *rest,
     block_size: int,
     num_kv_heads: int,
     q_per_kv: int,
     scale: float,
+    quantized: bool,
 ):
+    if quantized:
+        (k_sc_ref, v_sc_ref, o_ref, m_ref, l_ref,
+         k_buf, v_buf, sem_k, sem_v) = rest
+    else:
+        k_sc_ref = v_sc_ref = None
+        o_ref, m_ref, l_ref, k_buf, v_buf, sem_k, sem_v = rest
+    # o_ref: VMEM [1, H, Dh]; m_ref/l_ref: VMEM [1, 1, H] f32 (running max
+    # pre-normalization / softmax denominator); k_buf/v_buf: VMEM
+    # [NUM_BUFS, Hkv, SUPER_TOKENS/PACK, Dh*PACK] pool-dtype scratch;
+    # sem_k/sem_v: DMA sems (NUM_BUFS, pages_per_super).
     b = pl.program_id(0)
     layer = layer_ref[0]
     bs = block_size
@@ -166,11 +172,20 @@ def _decode_kernel(
         s_parts = []
         for f in range(pack):
             kf = k_sup[:, :, f * dh:(f + 1) * dh]          # [Hkv, S/P, Dh]
+            if quantized:
+                # int8 payload: the raw dot is exact in f32 (|q| <= 127);
+                # the per-slot dequant scale is a rank-1 factor on the KEY
+                # axis, so it multiplies the scores instead of the payload
+                # — K never materializes dequantized.
+                kf = kf.astype(jnp.float32)
             scores = jax.lax.dot_general(
                 q, kf,
                 dimension_numbers=(((2,), (2,)), ((0,), (0,))),
                 preferred_element_type=jnp.float32,
             )                                               # [Hkv, G, S/P]
+            if quantized:
+                ksc = k_sc_ref[0, f, :, pl.ds(s * stp, stp)]  # [Hkv, S/P]
+                scores = scores * ksc[:, None, :]
             pos = s * SUPER_TOKENS + pack * jax.lax.broadcasted_iota(
                 jnp.int32, (1, 1, stp), 2
             ) + f
@@ -186,6 +201,12 @@ def _decode_kernel(
             p_ = jnp.exp(s_parts[f] - m_new)               # [Hkv, G, S/P]
             l_new = l_new + jnp.sum(p_, axis=-1, keepdims=True)
             vf = v_sup[:, :, f * dh:(f + 1) * dh]
+            if quantized:
+                # Same rank-1 trick on the VALUE side: fold each slot's
+                # scale into its softmax weight before the PV contraction.
+                vf = vf.astype(jnp.float32)
+                vsc = v_sc_ref[0, f, :, pl.ds(s * stp, stp)]  # [Hkv, S/P]
+                p_ = p_ * vsc[:, None, :]
             acc_new = acc_new + jax.lax.dot_general(
                 p_, vf,
                 dimension_numbers=(((2,), (1,)), ((0,), (0,))),
@@ -227,6 +248,8 @@ def paged_flash_decode_stats(
     block_size: int,
     scale: Optional[float] = None,
     interpret: bool = False,
+    k_scale: Optional[jax.Array] = None,  # [L, Hkv, num_slots] — int8 pools
+    v_scale: Optional[jax.Array] = None,
 ) -> tuple:
     """Pool-segment flash decode for one layer of the stacked pool.
 
@@ -234,6 +257,16 @@ def paged_flash_decode_stats(
     caller can merge with other attention segments (see
     ops/attention.py:merge_attention_segments). Rows with kv_len == 0 return
     (0, -inf, 0) — a no-op under the merge.
+
+    Quantized pools (``k_scale``/``v_scale`` set, int8 payload): the page
+    DMAs move int8 — half the bf16 HBM traffic — and dequantization happens
+    INSIDE the kernel as rank-1 score/weight scaling; a bf16 copy of the
+    pool never exists. The per-slot scales the dispatch can touch are
+    gathered OUTSIDE the kernel ([B, Mb*bs] per head — a few hundred KB
+    against the pool's GBs) because page-granular scale rows are far below
+    the 128-lane DMA grain; they ride in as a lane-half-major VMEM input
+    ``[B, PACK, Hkv, Mb*bs/PACK]`` so lane-half f of superpage s slices
+    contiguously in-kernel.
     """
     b, h, dh = q.shape
     l_, hkv, num_slots, _ = k_pool.shape
@@ -242,15 +275,51 @@ def paged_flash_decode_stats(
         scale = dh ** -0.5
     pack = _pack(dh)
     spp = SUPER_TOKENS // block_size
+    quantized = k_scale is not None
+    layer = jnp.asarray(layer_idx, jnp.int32).reshape(1)
 
     # Lane-pack the pool view: [L, Hkv, NS/PACK, Dh*PACK] (free reshape).
     kp = k_pool.reshape(l_, hkv, num_slots // pack, dh * pack)
     vp = v_pool.reshape(l_, hkv, num_slots // pack, dh * pack)
 
+    sc_inputs = []
+    sc_specs = []
+    if quantized:
+        mb = block_tables.shape[1]
+        nb = num_slots // block_size
+        # Pad the window to whole SUPERPAGES: the kernel slices
+        # SUPER_TOKENS/PACK scale rows per compute iteration even when the
+        # block table covers less (tail scores there are masked by
+        # pos >= kv_len, so the zero padding is never read into a result).
+        total = mb * block_size
+        padded = pl.cdiv(total, SUPER_TOKENS) * SUPER_TOKENS
+
+        def sc_window(sc_pool):
+            # This layer's per-slot scales at the dispatch's pages:
+            # [Hkv, NS] -> gather blocks -> [Hkv, B, Mb*bs] -> lane-half
+            # major [B, PACK, Hkv, padded/PACK] f32 (token t of a row's
+            # window = half t%PACK, packed row t//PACK).
+            sc_l = jnp.take(sc_pool, layer[0], axis=0)      # [Hkv, NS]
+            scw = sc_l.reshape(hkv, nb, block_size)[:, block_tables]
+            scw = scw.reshape(hkv, b, total)
+            if padded != total:
+                scw = jnp.pad(scw, ((0, 0), (0, 0), (0, padded - total)))
+            scw = scw.reshape(hkv, b, padded // pack, pack)
+            return scw.transpose(1, 3, 0, 2).astype(jnp.float32)
+
+        sc_inputs = [sc_window(k_scale), sc_window(v_scale)]
+        sc_block = (1, pack, hkv, padded // pack)
+        sc_specs = [
+            pl.BlockSpec(sc_block, lambda i, *_: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(sc_block, lambda i, *_: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ]
+
     kernel = functools.partial(
         _decode_kernel,
         block_size=block_size, num_kv_heads=hkv, q_per_kv=g,
-        scale=float(scale),
+        scale=float(scale), quantized=quantized,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
@@ -262,6 +331,7 @@ def paged_flash_decode_stats(
             ),
             pl.BlockSpec(memory_space=pl.ANY),  # pool stays off-chip;
             pl.BlockSpec(memory_space=pl.ANY),  # kernel DMAs pages itself
+            *sc_specs,
         ],
         out_specs=[
             pl.BlockSpec(
@@ -297,8 +367,8 @@ def paged_flash_decode_stats(
         grid_spec=grid_spec,
         interpret=interpret,
     )(
-        jnp.asarray(layer_idx, jnp.int32).reshape(1),
-        block_tables, kv_lens, q, kp, vp,
+        layer,
+        block_tables, kv_lens, q, kp, vp, *sc_inputs,
     )
     return out, m.reshape(b, h), l.reshape(b, h)
 
@@ -315,6 +385,8 @@ def paged_flash_decode_stats_tp(
     block_size: int,
     scale: Optional[float] = None,
     interpret: bool = False,
+    k_scale: Optional[jax.Array] = None,  # [L, Hkv, num_slots] — kv-head
+    v_scale: Optional[jax.Array] = None,  # sharded like the pools
 ) -> tuple:
     """TP-sharded pool-segment flash decode via shard_map over kv heads.
 
@@ -334,29 +406,43 @@ def paged_flash_decode_stats_tp(
 
     from production_stack_tpu.parallel.mesh import AXIS_TP, shard_map
 
-    fn = functools.partial(
-        paged_flash_decode_stats,
-        block_size=block_size, scale=scale, interpret=interpret,
+    quantized = k_scale is not None
+
+    def fn(q_, kp_, vp_, bt_, lens_, li_, *sc_):
+        ks_, vs_ = sc_ if quantized else (None, None)
+        return paged_flash_decode_stats(
+            q_, kp_, vp_, bt_, lens_, li_,
+            block_size=block_size, scale=scale, interpret=interpret,
+            k_scale=ks_, v_scale=vs_,
+        )
+
+    in_specs = (
+        P(None, AXIS_TP, None),        # q: heads sharded
+        P(None, AXIS_TP, None, None),  # pools: kv heads sharded
+        P(None, AXIS_TP, None, None),
+        P(None, None),                 # block tables replicated
+        P(None,),                      # kv lens replicated
+        P(None,),                      # layer index replicated
     )
+    args = (q, k_pool, v_pool, block_tables, kv_lens,
+            jnp.asarray(layer_idx, jnp.int32).reshape(1))
+    if quantized:
+        # Scale pools share the pools' kv-head sharding, so each shard
+        # dequantizes its local heads with local scales — still collective-
+        # free.
+        in_specs += (P(None, AXIS_TP, None), P(None, AXIS_TP, None))
+        args += (k_scale, v_scale)
     return shard_map(
         fn,
         mesh=mesh,
-        in_specs=(
-            P(None, AXIS_TP, None),        # q: heads sharded
-            P(None, AXIS_TP, None, None),  # pools: kv heads sharded
-            P(None, AXIS_TP, None, None),
-            P(None, None),                 # block tables replicated
-            P(None,),                      # kv lens replicated
-            P(None,),                      # layer index replicated
-        ),
+        in_specs=in_specs,
         out_specs=(
             P(None, AXIS_TP, None),        # out [B, H, Dh]
             P(None, AXIS_TP),              # m [B, H]
             P(None, AXIS_TP),              # l [B, H]
         ),
         check_vma=False,
-    )(q, k_pool, v_pool, block_tables, kv_lens,
-      jnp.asarray(layer_idx, jnp.int32).reshape(1))
+    )(*args)
 
 
 @functools.partial(
@@ -372,6 +458,8 @@ def paged_attention_decode_pallas(
     block_size: int,
     scale: Optional[float] = None,
     interpret: bool = False,
+    k_scale: Optional[jax.Array] = None,  # [Hkv, num_slots] (int8 pools)
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Single-layer convenience wrapper (normalized output only)."""
     b, t, h, dh = q.shape
@@ -380,6 +468,8 @@ def paged_attention_decode_pallas(
         q.reshape(b, h, dh), k_pool[None], v_pool[None], block_tables,
         kv_lens, jnp.zeros((1,), jnp.int32),
         block_size=block_size, scale=scale, interpret=interpret,
+        k_scale=None if k_scale is None else k_scale[None],
+        v_scale=None if v_scale is None else v_scale[None],
     )
     return out.reshape(b, 1, h, dh)
 
@@ -387,7 +477,7 @@ def paged_attention_decode_pallas(
 def paged_attention_pallas(
     q, k_pool, v_pool, block_tables, kv_lens, q_positions,
     *, block_size: int, scale: Optional[float] = None,
-    interpret: bool = False,
+    interpret: bool = False, k_scale=None, v_scale=None,
 ):
     """Dispatch: decode (T==1, supported head_dim) runs the flash-decode
     kernel; everything else falls back to the XLA gather path."""
@@ -395,10 +485,12 @@ def paged_attention_pallas(
         return paged_attention_decode_pallas(
             q, k_pool, v_pool, block_tables, kv_lens,
             block_size=block_size, scale=scale, interpret=interpret,
+            k_scale=k_scale, v_scale=v_scale,
         )
     from production_stack_tpu.ops.attention import paged_attention_xla
 
     return paged_attention_xla(
         q, k_pool, v_pool, block_tables, kv_lens, q_positions,
         block_size=block_size, scale=scale,
+        k_scale=k_scale, v_scale=v_scale,
     )

@@ -1,7 +1,10 @@
 from production_stack_tpu.parallel.mesh import make_mesh
 from production_stack_tpu.parallel.sharding import (
     kv_pool_sharding,
+    kv_scale_sharding,
     param_shardings,
 )
 
-__all__ = ["make_mesh", "param_shardings", "kv_pool_sharding"]
+__all__ = [
+    "make_mesh", "param_shardings", "kv_pool_sharding", "kv_scale_sharding",
+]

@@ -72,3 +72,12 @@ def kv_pool_sharding(cfg: ModelConfig, mesh: Mesh) -> NamedSharding:
     return _shard_if_divisible(
         mesh, cfg.num_kv_heads, (None, AXIS_TP, None, None)
     )
+
+
+def kv_scale_sharding(cfg: ModelConfig, mesh: Mesh) -> NamedSharding:
+    """Per-slot dequant scale pools [L, Hkv, num_slots] for int8 KV caches
+    (--kv-cache-dtype int8): kv-head-sharded exactly like the payload pools
+    so each tp shard dequantizes its local heads with local scales."""
+    return _shard_if_divisible(
+        mesh, cfg.num_kv_heads, (None, AXIS_TP, None)
+    )

@@ -1079,6 +1079,7 @@ def build_engine_from_args(args: argparse.Namespace) -> ServingEngine:
         model=args.model,
         served_model_name=args.served_model_name,
         dtype=args.dtype,
+        kv_cache_dtype=args.kv_cache_dtype,
         max_model_len=args.max_model_len,
         block_size=args.block_size,
         num_kv_blocks=args.num_kv_blocks,
@@ -1117,7 +1118,14 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--served-model-name", default=None,
                    help="name advertised on /v1/models (default: --model)")
     p.add_argument("--dtype", default="bfloat16",
-                   help="compute/KV dtype (bfloat16 | float32)")
+                   help="compute dtype (bfloat16 | float32)")
+    p.add_argument("--kv-cache-dtype", default="bfloat16",
+                   choices=["bfloat16", "int8"],
+                   help="KV-cache STORAGE dtype: int8 stores K/V with "
+                        "per-(slot, head) bf16 scales and dequantizes "
+                        "inline on read — ~half the decode HBM/wire bytes "
+                        "and ~2x the KV blocks per HBM byte "
+                        "(docs/PERF.md round 7)")
     p.add_argument("--max-model-len", type=int, default=2048,
                    help="max prompt+generation length in tokens")
     p.add_argument("--block-size", type=int, default=16,

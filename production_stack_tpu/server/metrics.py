@@ -22,9 +22,11 @@ def render_engine_metrics(engine: "ServingEngine", model_name: str) -> str:
                 "dispatch_overlap_ratio", "dispatch_gap_seconds_total",
                 "kv_handoffs_total", "kv_handoff_bytes_total",
                 "kv_handoff_seconds_total", "kv_handoff_failures_total",
-                "engine_uptime_seconds", "kv_offload_blocks"):
+                "engine_uptime_seconds", "kv_offload_blocks",
+                "kv_quant_bytes_saved_total"):
         s.setdefault(key, 0)
     s.setdefault("disagg_role", "unified")
+    s.setdefault("kv_cache_dtype", "bfloat16")
     label = f'{{model_name="{model_name}"}}'
     lines = [
         "# HELP vllm:num_requests_running Running requests",
@@ -108,6 +110,19 @@ def render_engine_metrics(engine: "ServingEngine", model_name: str) -> str:
         "# TYPE pstpu:kv_handoff_failures_total counter",
         f"pstpu:kv_handoff_failures_total{label} "
         f"{s['kv_handoff_failures_total']}",
+        # KV-cache quantization (--kv-cache-dtype int8, docs/PERF.md round
+        # 7): storage dtype as an info-style gauge + bytes the quantized
+        # pool avoided writing (collector renders the same pair).
+        "# HELP pstpu:kv_cache_dtype KV-cache storage dtype of the block "
+        "pool (1 = active)",
+        "# TYPE pstpu:kv_cache_dtype gauge",
+        f'pstpu:kv_cache_dtype{{model_name="{model_name}",'
+        f'kv_cache_dtype="{s["kv_cache_dtype"]}"}} 1',
+        "# HELP pstpu:kv_quant_bytes_saved_total KV-pool bytes the "
+        "quantized cache avoided writing vs the compute dtype",
+        "# TYPE pstpu:kv_quant_bytes_saved_total counter",
+        f"pstpu:kv_quant_bytes_saved_total{label} "
+        f"{s['kv_quant_bytes_saved_total']}",
     ]
     # TTFT / e2e latency distributions (the reference dashboard's two
     # distribution panels query these bucket series).

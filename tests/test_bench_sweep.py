@@ -200,7 +200,7 @@ def test_bench_stack_routing_and_kv_hit_wiring(monkeypatch):
     args = argparse.Namespace(
         model="facebook/opt-125m", users=2, rounds=2, prompt_len=15,
         max_tokens=8, max_model_len=2048, attn_impl="auto",
-        decode_loop=None, no_overlap=False,
+        kv_cache_dtype="bfloat16", decode_loop=None, no_overlap=False,
         routing_logic="cache_aware_load_balancing", num_engines=2,
         history_tokens=500,
     )

@@ -22,7 +22,8 @@ def test_serde_roundtrip():
         k = np.arange(2 * 2 * 4 * 8, dtype=np.float32).reshape(2, 2, 4, 8)
         v = (k * 2).astype(dtype)
         k = k.astype(dtype)
-        k2, v2 = unpack_block(pack_block(k, v))
+        k2, v2, ks2, vs2 = unpack_block(pack_block(k, v))
+        assert ks2 is None and vs2 is None  # PKV1: no scale planes
         np.testing.assert_array_equal(np.asarray(k2), np.asarray(k))
         np.testing.assert_array_equal(np.asarray(v2), np.asarray(v))
 

@@ -105,6 +105,13 @@ REGISTRY: Tuple[Series, ...] = (
            _BOTH_ENGINE, ("catalogue", "dispatch"),
            "Host-observed seconds with no dispatch outstanding "
            "(pipeline bubble)"),
+    Series("pstpu:kv_cache_dtype", "gauge", ("model_name", "kv_cache_dtype"),
+           _BOTH_ENGINE, ("catalogue", "dispatch"),
+           "KV-cache storage dtype of the block pool (1 = active)"),
+    Series("pstpu:kv_quant_bytes_saved_total", "counter", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "dispatch"),
+           "KV-pool bytes the quantized cache avoided writing vs the "
+           "compute dtype"),
     Series("pstpu:disagg_role", "gauge", ("model_name", "role"),
            _BOTH_ENGINE, ("catalogue", "disagg"),
            "Engine disaggregation role (1 = active)"),
