@@ -349,6 +349,7 @@ async def _generate_all(engine, prompts, max_tokens=24):
     return outs
 
 
+@pytest.mark.slow
 async def test_engine_int8_parity_and_readpath_consistency():
     """The parity bar for the quantized path, on the existing parity prompt
     set: (1) window and paged read paths over the SAME int8 pool produce
@@ -397,6 +398,7 @@ async def test_engine_int8_parity_and_readpath_consistency():
         assert bf[i][0] == i8[i][0]
 
 
+@pytest.mark.slow
 async def test_engine_int8_paged_tp2_matches_tp1():
     """tp=2 shards the int8 pools AND their scale sidecars over kv heads
     (parallel/sharding.py:kv_scale_sharding); the shard_mapped kernel must

@@ -74,6 +74,10 @@ async def _drive(engine):
     return results
 
 
+# Slow-marked: ~30s each on CPU (three full engine runs across loop modes /
+# preemption under live dispatches). CI's "Pipeline parity + dispatch
+# overlap" explicit step runs this whole file without the marker filter.
+@pytest.mark.slow
 @pytest.mark.asyncio
 async def test_pipeline_matches_strict_loop():
     outs = {}
@@ -127,6 +131,7 @@ async def test_pipeline_abort_mid_flight():
         await engine.stop()
 
 
+@pytest.mark.slow
 @pytest.mark.asyncio
 async def test_pipeline_preemption_discards_inflight():
     """Preemption under pool pressure while (up to two) dispatches are in
