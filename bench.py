@@ -485,7 +485,44 @@ def main():
                          "routed with --routing-logic disagg; reports "
                          "per-role TTFT/ITL and kv_handoff_* telemetry "
                          "(docs/DISAGG.md)")
+    # Sustained-load SLO soak + chaos gate (benchmarks/soak.py,
+    # docs/SOAK.md): minutes of multi-round QA at a QPS ladder with
+    # per-class SLO attainment and mid-soak fault injection; the report is
+    # recorded as BENCH_soak_r*.json and the zero-5xx/bounded-recovery
+    # bars fail the run.
+    ap.add_argument("--soak", action="store_true",
+                    help="run the sustained-load SLO soak + chaos gate "
+                         "instead of a single-shot benchmark "
+                         "(docs/SOAK.md); prints the pstpu-soak-v1 JSON "
+                         "report and exits nonzero if the zero-5xx or "
+                         "bounded-recovery bar fails")
+    ap.add_argument("--soak-qps-ladder", default="0.5,1.0",
+                    help="comma-separated session-launch QPS rungs")
+    ap.add_argument("--soak-rung-duration", type=float, default=45.0,
+                    help="seconds of sustained traffic per ladder rung")
+    ap.add_argument("--soak-fault-schedule", default=None,
+                    help="declarative chaos schedule: JSON list or "
+                         "@path/to/schedule.json (actions: restart_engine, "
+                         "restart_kv_server, degrade_engine, heal_engine)")
+    ap.add_argument("--soak-classes", default=None,
+                    help="SLO classes as a JSON list or @path (default: "
+                         "interactive + batch, docs/SOAK.md)")
+    ap.add_argument("--soak-max-recovery", type=float, default=90.0,
+                    help="bounded post-fault recovery: seconds within "
+                         "which windowed attainment must return above "
+                         "threshold")
+    ap.add_argument("--soak-max-queue-len", type=int, default=32,
+                    help="engine admission bound during the soak (shed "
+                         "with 503+Retry-After beyond it)")
+    ap.add_argument("--soak-output", default=None,
+                    help="write the soak report JSON here (e.g. "
+                         "BENCH_soak_r01.json) in addition to stdout")
     args = ap.parse_args()
+    for attr in ("soak_fault_schedule", "soak_classes"):
+        val = getattr(args, attr)
+        if val and val.startswith("@"):
+            with open(val[1:]) as f:
+                setattr(args, attr, f.read())
 
     # Probe the backend in a SUBPROCESS: in stack mode the parent must not
     # initialize the device client — the engine subprocess owns the chip.
@@ -498,6 +535,20 @@ def main():
     on_tpu = backend not in ("", "cpu")
     args.model = args.model or ("llama-1b" if on_tpu else "tiny-llama")
     args.backend = backend
+
+    if args.soak:
+        from benchmarks.soak import assert_soak_bars, run_soak
+
+        if args.num_engines < 2:
+            args.num_engines = 2   # chaos needs a peer to fail over to
+        report = run_soak(args)
+        print(json.dumps(report))
+        if args.soak_output:
+            with open(args.soak_output, "w") as f:
+                json.dump(report, f, indent=1)
+                f.write("\n")
+        assert_soak_bars(report, args.soak_max_recovery)
+        return 0
 
     if args.disagg:
         args.mode = "stack"  # disagg is a stack-shape run (JSON line parity)

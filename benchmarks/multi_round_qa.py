@@ -75,6 +75,22 @@ class WorkloadConfig:
     # (intentionally) shared system prefix is warm in the engine's prefix
     # cache, not the full prompts.
     tag: str = "round"
+    # Extra headers on every request (soak SLO classes ride x-slo-class /
+    # x-slo-ttft / x-ttft-deadline through here).
+    extra_headers: Optional[dict] = None
+    # 503 + Retry-After is intentional shedding, not a failure: back off
+    # for the advertised interval and retry, up to max_shed_retries per
+    # round. The retries are counted on the record (``sheds``) so load
+    # reports can separate shed from error.
+    honor_retry_after: bool = True
+    max_shed_retries: int = 5
+    # True (default): any terminal non-2xx status raises, the historical
+    # bench contract. False (soak): the outcome is recorded on the
+    # RequestRecord (status, transport errors as status 599) and the
+    # workload keeps going — the soak report does the accounting.
+    raise_on_error: bool = True
+    # Label stamped on every record (soak per-class attribution).
+    slo_class: str = ""
 
 
 @dataclass
@@ -86,10 +102,26 @@ class RequestRecord:
     finish_time: float
     prompt_tokens: int
     generation_tokens: int
+    status: int = 200          # terminal HTTP status (599 = transport error)
+    sheds: int = 0             # 503+Retry-After backoff-and-retry rounds
+    retry_after: bool = False  # terminal 503 carried Retry-After (shed, not
+                               # error — docs/SOAK.md accounting)
+    slo_class: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return 200 <= self.status < 300
 
     @property
     def generation_time(self) -> float:
         return max(self.finish_time - self.launch_time - self.ttft, 1e-9)
+
+    @property
+    def itl(self) -> Optional[float]:
+        """Mean inter-token latency after the first token, seconds."""
+        if self.generation_tokens <= 1:
+            return None
+        return self.generation_time / (self.generation_tokens - 1)
 
 
 class UserSession:
@@ -149,6 +181,8 @@ class UserSession:
         headers = {cfg.session_header: f"user-{self.user_id}"}
         if cfg.api_key:
             headers["Authorization"] = f"Bearer {cfg.api_key}"
+        if cfg.extra_headers:
+            headers.update(cfg.extra_headers)
         body = {
             "model": cfg.model,
             "messages": self.messages,
@@ -162,35 +196,90 @@ class UserSession:
         first: Optional[float] = None
         answer = ""
         prompt_tokens = generation_tokens = 0
-        async with http.post(
-            f"{cfg.base_url}/v1/chat/completions", json=body, headers=headers,
-        ) as resp:
-            resp.raise_for_status()
-            async for raw in resp.content:
-                line = raw.decode("utf-8", "replace").strip()
-                if not line.startswith("data:"):
-                    continue
-                payload = line[len("data:"):].strip()
-                if payload == "[DONE]":
+        status = 599               # transport error unless a response lands
+        retry_after_hdr: Optional[str] = None
+        sheds = 0
+        while True:
+            try:
+                async with http.post(
+                    f"{cfg.base_url}/v1/chat/completions", json=body,
+                    headers=headers,
+                ) as resp:
+                    status = resp.status
+                    retry_after_hdr = resp.headers.get("Retry-After")
+                    if (status == 503 and retry_after_hdr is not None
+                            and cfg.honor_retry_after
+                            and sheds < cfg.max_shed_retries):
+                        # Intentional shed (queue bound / drain / breaker):
+                        # back off for the advertised interval and retry —
+                        # NOT an error (docs/SOAK.md accounting).
+                        await resp.read()
+                        sheds += 1
+                        try:
+                            delay = min(5.0, float(retry_after_hdr))
+                        except ValueError:
+                            delay = 1.0
+                        await asyncio.sleep(delay)
+                        continue
+                    if status >= 400:
+                        await resp.read()
+                        if cfg.raise_on_error:
+                            resp.raise_for_status()
+                        break
+                    saw_done = False
+                    async for raw in resp.content:
+                        line = raw.decode("utf-8", "replace").strip()
+                        if not line.startswith("data:"):
+                            continue
+                        payload = line[len("data:"):].strip()
+                        if payload == "[DONE]":
+                            saw_done = True
+                            break
+                        chunk = json.loads(payload)
+                        usage = chunk.get("usage")
+                        if usage:
+                            prompt_tokens = usage.get("prompt_tokens", 0)
+                            generation_tokens = usage.get(
+                                "completion_tokens", 0)
+                        for choice in chunk.get("choices", []):
+                            delta = (choice.get("delta") or {}).get("content")
+                            if delta:
+                                if first is None:
+                                    first = time.monotonic()
+                                answer += delta
+                    if not saw_done:
+                        # Stream ended without the terminal sentinel: a
+                        # mid-stream truncation (backend died after bytes
+                        # were on the wire — truncation-only semantics,
+                        # docs/RESILIENCE.md). The client saw a broken
+                        # answer, so it counts as an error, not a 200 —
+                        # otherwise the soak's zero-5xx gate would be
+                        # blind to hard mid-stream kills.
+                        status = 599
                     break
-                chunk = json.loads(payload)
-                usage = chunk.get("usage")
-                if usage:
-                    prompt_tokens = usage.get("prompt_tokens", 0)
-                    generation_tokens = usage.get("completion_tokens", 0)
-                for choice in chunk.get("choices", []):
-                    delta = (choice.get("delta") or {}).get("content")
-                    if delta:
-                        if first is None:
-                            first = time.monotonic()
-                        answer += delta
+            except aiohttp.ClientResponseError:
+                raise              # raise_on_error path (status preserved)
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+                status = 599       # transport failure — always an error
+                retry_after_hdr = None
+                if cfg.raise_on_error:
+                    raise
+                break
         finish = time.monotonic()
-        self.messages.append({"role": "assistant", "content": answer})
+        if 200 <= status < 300:
+            self.messages.append({"role": "assistant", "content": answer})
+        else:
+            # Keep the conversation alternating for later rounds: a failed
+            # round contributes no turns.
+            self.messages.pop()
         self.records.append(RequestRecord(
             user=self.user_id, round=rnd, launch_time=launch,
             ttft=(first if first is not None else finish) - launch,
             finish_time=finish, prompt_tokens=prompt_tokens,
             generation_tokens=generation_tokens,
+            status=status, sheds=sheds,
+            retry_after=retry_after_hdr is not None,
+            slo_class=cfg.slo_class,
         ))
 
     async def run(self, http: aiohttp.ClientSession, start_delay: float,
@@ -242,28 +331,43 @@ def write_csv(records: List[RequestRecord], path: str) -> None:
 
 
 def summarize(records: List[RequestRecord]) -> dict:
-    """ProcessSummary-equivalent (reference multi-round-qa.py:435-512)."""
-    if not records:
-        return {"finished_requests": 0}
-    start = min(r.launch_time for r in records)
-    end = max(r.finish_time for r in records)
+    """ProcessSummary-equivalent (reference multi-round-qa.py:435-512).
+
+    Rate/latency metrics cover the OK records only; shed retries and
+    terminal failures are accounted separately (``shed_total`` /
+    ``errors_total`` — 503+Retry-After outcomes are shed, not errors)."""
+    ok = [r for r in records if r.ok]
+    shed_total = sum(r.sheds for r in records) + sum(
+        1 for r in records if r.status == 503 and r.retry_after
+    )
+    errors_total = sum(
+        1 for r in records
+        if not r.ok and not (r.status == 503 and r.retry_after)
+    )
+    if not ok:
+        return {"finished_requests": 0, "shed_total": shed_total,
+                "errors_total": errors_total}
+    start = min(r.launch_time for r in ok)
+    end = max(r.finish_time for r in ok)
     total_time = max(end - start, 1e-9)
-    ttfts = sorted(r.ttft for r in records)
-    gen_tokens = sum(r.generation_tokens for r in records)
+    ttfts = sorted(r.ttft for r in ok)
+    gen_tokens = sum(r.generation_tokens for r in ok)
     return {
-        "finished_requests": len(records),
-        "qps": len(records) / total_time,
-        "input_tokens_per_s": sum(r.prompt_tokens for r in records) / total_time,
+        "finished_requests": len(ok),
+        "qps": len(ok) / total_time,
+        "input_tokens_per_s": sum(r.prompt_tokens for r in ok) / total_time,
         "output_tokens_per_s": gen_tokens / total_time,
         "gen_speed_per_request": (
-            sum(r.generation_tokens / r.generation_time for r in records)
-            / len(records)
+            sum(r.generation_tokens / r.generation_time for r in ok)
+            / len(ok)
         ),
         "avg_ttft_s": sum(ttfts) / len(ttfts),
         "p50_ttft_s": ttfts[len(ttfts) // 2],
         "total_output_tokens": gen_tokens,
-        "total_prompt_tokens": sum(r.prompt_tokens for r in records),
+        "total_prompt_tokens": sum(r.prompt_tokens for r in ok),
         "elapsed_s": total_time,
+        "shed_total": shed_total,
+        "errors_total": errors_total,
     }
 
 

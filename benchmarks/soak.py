@@ -1,0 +1,704 @@
+"""Sustained-load SLO soak harness with chaos gate (docs/SOAK.md).
+
+Single bench.py shots cannot see drift, fragmentation, or breaker flap —
+the reference stack's whole benchmark plane exists because of that
+(PAPER.md §1, reference benchmarks/multi-round-qa). This module runs
+MINUTES of multi-round QA at a QPS ladder against the full subprocess
+stack (router + engines + kv-offload server, benchmarks/stack.py), with:
+
+  * per-class workloads (interactive vs batch) carrying distinct soft
+    TTFT/ITL SLOs (``x-slo-class`` / ``x-slo-ttft``) and a hard TTFT
+    deadline riding the PR-1 ``x-ttft-deadline`` machinery;
+  * per-rung, per-class SLO attainment: p99 TTFT/ITL, goodput under
+    overload, shed-vs-error accounting where 503+Retry-After is NOT a
+    failure (the stack sheds on purpose — docs/RESILIENCE.md);
+  * a declarative mid-soak fault schedule (engine restart, kv-server
+    restart, slow-straggler degrade) with the zero-5xx bar asserted
+    end-to-end and post-fault recovery time measured;
+  * a stable JSON report schema (``pstpu-soak-v1``) recorded as
+    BENCH_soak_r*.json so robustness regressions are trajectory diffs.
+
+Driven by ``python bench.py --soak``; the ladder/attainment math is pure
+(tests/test_soak.py runs it on synthetic latency streams, CPU-only).
+"""
+
+import asyncio
+import json
+import math
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+SCHEMA = "pstpu-soak-v1"
+
+#: Fault actions the chaos executor understands. ``degrade_engine`` /
+#: ``heal_engine`` require the target to serve POST /fault (the fake
+#: engine does; real engines answer 404 and the fault is recorded as
+#: skipped, never a soak failure).
+FAULT_ACTIONS = (
+    "restart_engine", "restart_kv_server", "degrade_engine", "heal_engine",
+)
+
+#: Router gauges the autoscaler wiring targets (docs/SOAK.md); the soak
+#: verifies all of them are live on the router's /metrics at the end.
+AUTOSCALER_GAUGES = (
+    "router_queue_depth", "router_kv_pressure",
+    "router_pool_utilization", "router_slo_attainment",
+)
+
+
+@dataclass(frozen=True)
+class SLOClass:
+    """One traffic class of the soak workload."""
+
+    name: str
+    ttft_slo_s: float            # soft target: attainment is measured on it
+    itl_slo_s: float             # soft per-token cadence target
+    answer_tokens: int
+    share: float                 # fraction of the rung's session-launch QPS
+    rounds: int = 2              # rounds per session (multi-round traffic)
+    question_words: int = 12
+    ttft_deadline_s: float = 0.0  # hard x-ttft-deadline (0 = none)
+
+    def headers(self) -> Dict[str, str]:
+        h = {"x-slo-class": self.name, "x-slo-ttft": str(self.ttft_slo_s)}
+        if self.ttft_deadline_s > 0:
+            h["x-ttft-deadline"] = str(self.ttft_deadline_s)
+        return h
+
+    def met(self, record) -> bool:
+        """Did an OK record meet this class's soft SLOs?"""
+        if record.ttft > self.ttft_slo_s:
+            return False
+        itl = record.itl
+        return itl is None or itl <= self.itl_slo_s
+
+
+def default_classes(on_tpu: bool = False) -> Tuple[SLOClass, ...]:
+    """Interactive (tight TTFT/ITL, short answers) vs batch (throughput,
+    loose latency). CPU targets are looser — the point of the soak is the
+    TRAJECTORY of attainment, not an absolute latency bar."""
+    if on_tpu:
+        return (
+            SLOClass("interactive", ttft_slo_s=1.0, itl_slo_s=0.1,
+                     answer_tokens=32, share=0.7, ttft_deadline_s=30.0),
+            SLOClass("batch", ttft_slo_s=5.0, itl_slo_s=0.5,
+                     answer_tokens=96, share=0.3),
+        )
+    return (
+        SLOClass("interactive", ttft_slo_s=8.0, itl_slo_s=0.6,
+                 answer_tokens=24, share=0.7, ttft_deadline_s=120.0),
+        SLOClass("batch", ttft_slo_s=30.0, itl_slo_s=2.0,
+                 answer_tokens=64, share=0.3),
+    )
+
+
+def parse_classes(spec) -> Tuple[SLOClass, ...]:
+    """SLO classes from a JSON list (string or parsed):
+    [{"name": ..., "ttft_slo_s": ..., "itl_slo_s": ...,
+      "answer_tokens": ..., "share": ..., ...}, ...]."""
+    if isinstance(spec, str):
+        spec = json.loads(spec)
+    classes = []
+    for item in spec:
+        if not isinstance(item, dict):
+            raise ValueError(f"SLO class entry must be an object: {item!r}")
+        for key in ("name", "ttft_slo_s", "itl_slo_s", "answer_tokens",
+                    "share"):
+            if key not in item:
+                raise ValueError(f"SLO class entry missing {key!r}: {item!r}")
+        classes.append(SLOClass(**item))
+    if not classes:
+        raise ValueError("at least one SLO class is required")
+    return tuple(classes)
+
+
+# ------------------------------------------------------------ fault schedule
+@dataclass(frozen=True)
+class Fault:
+    at_s: float                  # offset from ladder start
+    action: str
+    engine: int = 0              # restart_engine/degrade_engine target index
+    params: Dict = field(default_factory=dict)   # e.g. straggler itl/jitter
+
+
+def parse_fault_schedule(spec) -> Tuple[Fault, ...]:
+    """Declarative chaos schedule from a JSON list (string or parsed):
+    [{"at_s": 10, "action": "restart_engine", "engine": 1},
+     {"at_s": 25, "action": "restart_kv_server"},
+     {"at_s": 40, "action": "degrade_engine", "engine": 0,
+      "itl": 0.05, "jitter": 0.02},
+     {"at_s": 55, "action": "heal_engine", "engine": 0}]."""
+    if isinstance(spec, str):
+        spec = json.loads(spec)
+    faults = []
+    for item in spec:
+        if not isinstance(item, dict):
+            raise ValueError(f"fault entry must be an object: {item!r}")
+        action = item.get("action")
+        if action not in FAULT_ACTIONS:
+            raise ValueError(
+                f"unknown fault action {action!r} "
+                f"(known: {', '.join(FAULT_ACTIONS)})"
+            )
+        if "at_s" not in item:
+            raise ValueError(f"fault entry missing 'at_s': {item!r}")
+        at_s = float(item["at_s"])
+        if at_s < 0:
+            raise ValueError(f"fault 'at_s' must be >= 0: {item!r}")
+        engine = int(item.get("engine", 0))
+        params = {k: v for k, v in item.items()
+                  if k not in ("at_s", "action", "engine")}
+        faults.append(Fault(at_s=at_s, action=action, engine=engine,
+                            params=params))
+    return tuple(sorted(faults, key=lambda f: f.at_s))
+
+
+# --------------------------------------------------------- attainment math
+def percentile(values: Sequence[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile (q in [0, 1]); None on empty input."""
+    if not values:
+        return None
+    vals = sorted(values)
+    idx = min(len(vals) - 1, max(0, math.ceil(q * len(vals)) - 1))
+    return vals[idx]
+
+
+def is_shed(record) -> bool:
+    """Terminal 503+Retry-After: the stack refused on purpose."""
+    return record.status == 503 and record.retry_after
+
+
+def is_error(record) -> bool:
+    return not record.ok and not is_shed(record)
+
+
+def status_5xx(records) -> int:
+    """Client-visible hard failures: any terminal 5xx (transport errors
+    count as 599) EXCEPT 503+Retry-After, which is intentional shedding."""
+    return sum(
+        1 for r in records
+        if 500 <= r.status < 600 and not is_shed(r)
+    )
+
+
+def class_summary(records, slo: SLOClass, duration_s: float) -> dict:
+    """Per-class SLO attainment over one rung's records (pure).
+
+    Attainment = OK-and-met / (OK + errors): sheds are excluded from the
+    denominator (the request was never served, by design), errors count
+    as misses. Goodput = output tokens of SLO-meeting requests per
+    second of rung wall-clock — the throughput that actually helped a
+    user, the honest number under overload."""
+    ok = [r for r in records if r.ok]
+    met = [r for r in ok if slo.met(r)]
+    errors = sum(1 for r in records if is_error(r))
+    shed_terminal = sum(1 for r in records if is_shed(r))
+    shed_retries = sum(r.sheds for r in records)
+    served_or_failed = len(ok) + errors
+    ttfts = [r.ttft for r in ok]
+    itls = [r.itl for r in ok if r.itl is not None]
+    dur = max(duration_s, 1e-9)
+    return {
+        "requests": len(records),
+        "ok": len(ok),
+        "met": len(met),
+        "shed": shed_terminal,
+        "shed_retries": shed_retries,
+        "errors": errors,
+        "status_5xx": status_5xx(records),
+        "attainment": (len(met) / served_or_failed
+                       if served_or_failed else None),
+        "p50_ttft_s": percentile(ttfts, 0.50),
+        "p99_ttft_s": percentile(ttfts, 0.99),
+        "p99_itl_s": percentile(itls, 0.99),
+        "output_tok_s": sum(r.generation_tokens for r in ok) / dur,
+        "goodput_tok_s": sum(r.generation_tokens for r in met) / dur,
+        "slo": {"ttft_s": slo.ttft_slo_s, "itl_s": slo.itl_slo_s},
+    }
+
+
+def recovery_time(records, fault_at: float,
+                  classes: Sequence[SLOClass],
+                  window_s: float = 5.0, threshold: float = 0.9,
+                  horizon_s: float = 180.0) -> Optional[float]:
+    """Seconds from the fault until windowed attainment is back at or
+    above ``threshold`` (pure).
+
+    Completions after ``fault_at`` (monotonic clock, same as the records)
+    are bucketed into ``window_s`` windows; the recovery point is the END
+    of the first window whose ratio of SLO-meeting requests to ALL
+    terminal outcomes — errors AND sheds included, all classes pooled,
+    per-class SLOs applied — reaches the threshold. Unlike per-class
+    attainment, sheds count against recovery here: a stack refusing 95%
+    of its traffic gracefully has not recovered, any more than an empty
+    (starved) window has. None if no window within ``horizon_s``
+    qualifies."""
+    by_class = {c.name: c for c in classes}
+    post = [r for r in records if r.finish_time >= fault_at]
+    n_windows = max(1, int(math.ceil(horizon_s / window_s)))
+    for k in range(n_windows):
+        lo = fault_at + k * window_s
+        hi = lo + window_s
+        bucket = [r for r in post if lo <= r.finish_time < hi]
+        if not bucket:
+            continue
+        met = sum(
+            1 for r in bucket
+            if r.ok and by_class.get(r.slo_class,
+                                     classes[0]).met(r)
+        )
+        if met / len(bucket) >= threshold:
+            return hi - fault_at
+    return None
+
+
+# ------------------------------------------------------------- report schema
+REPORT_REQUIRED_KEYS = (
+    "schema", "metric", "model", "backend", "num_engines", "slo_classes",
+    "ladder", "faults", "faults_scheduled", "totals", "zero_5xx",
+    "autoscaler_gauges",
+)
+RUNG_REQUIRED_KEYS = ("qps", "duration_s", "users", "capped_classes",
+                      "classes")
+CLASS_REQUIRED_KEYS = (
+    "requests", "ok", "met", "shed", "shed_retries", "errors", "status_5xx",
+    "attainment", "p50_ttft_s", "p99_ttft_s", "p99_itl_s", "output_tok_s",
+    "goodput_tok_s", "slo",
+)
+FAULT_REQUIRED_KEYS = ("action", "at_s", "ok", "recovery_s", "recovery_ok")
+
+
+def validate_report(report: dict) -> None:
+    """Schema gate for BENCH_soak_*.json: later PRs diff these files, so
+    the key set is a contract. Raises ValueError on any missing key."""
+    for key in REPORT_REQUIRED_KEYS:
+        if key not in report:
+            raise ValueError(f"soak report missing key {key!r}")
+    if report["schema"] != SCHEMA:
+        raise ValueError(
+            f"soak report schema {report['schema']!r} != {SCHEMA!r}"
+        )
+    if not report["ladder"]:
+        raise ValueError("soak report has an empty ladder")
+    for rung in report["ladder"]:
+        for key in RUNG_REQUIRED_KEYS:
+            if key not in rung:
+                raise ValueError(f"ladder rung missing key {key!r}")
+        if not rung["classes"]:
+            raise ValueError("ladder rung has no classes")
+        for name, cls in rung["classes"].items():
+            for key in CLASS_REQUIRED_KEYS:
+                if key not in cls:
+                    raise ValueError(
+                        f"class {name!r} summary missing key {key!r}"
+                    )
+    for f in report["faults"]:
+        for key in FAULT_REQUIRED_KEYS:
+            if key not in f:
+                raise ValueError(f"fault record missing key {key!r}")
+
+
+def build_report(*, model: str, backend: str, num_engines: int,
+                 classes: Sequence[SLOClass], rungs: List[dict],
+                 faults: List[dict], autoscaler_gauges: Dict[str, bool],
+                 slo_attainment_gauge: Optional[Dict[str, float]] = None,
+                 faults_scheduled: Optional[int] = None,
+                 ) -> dict:
+    """Assemble + validate the soak report (pure; tests feed it synthetic
+    rung/fault data)."""
+    all_class = [c for rung in rungs for c in rung["classes"].values()]
+    totals = {
+        "requests": sum(c["requests"] for c in all_class),
+        "ok": sum(c["ok"] for c in all_class),
+        "shed": sum(c["shed"] for c in all_class),
+        "shed_retries": sum(c["shed_retries"] for c in all_class),
+        "errors": sum(c["errors"] for c in all_class),
+        "status_5xx": sum(c["status_5xx"] for c in all_class),
+    }
+    report = {
+        "schema": SCHEMA,
+        "metric": f"soak_slo_{model}",
+        "model": model,
+        "backend": backend,
+        "num_engines": num_engines,
+        "slo_classes": {
+            c.name: {"ttft_slo_s": c.ttft_slo_s, "itl_slo_s": c.itl_slo_s,
+                     "answer_tokens": c.answer_tokens, "share": c.share,
+                     "ttft_deadline_s": c.ttft_deadline_s}
+            for c in classes
+        },
+        "ladder": rungs,
+        "faults": faults,
+        # Scheduled vs executed: a fault scheduled past ladder end (or
+        # dropped by a bug) must be visible — the chaos gate fails on a
+        # shortfall rather than going green with no chaos injected.
+        "faults_scheduled": (len(faults) if faults_scheduled is None
+                             else faults_scheduled),
+        "totals": totals,
+        "zero_5xx": totals["status_5xx"] == 0 and totals["errors"] == 0,
+        "autoscaler_gauges": autoscaler_gauges,
+        "router_slo_attainment": slo_attainment_gauge or {},
+    }
+    validate_report(report)
+    return report
+
+
+class SoakViolation(AssertionError):
+    """The chaos gate failed: 5xx leaked to a client, or a fault's
+    recovery exceeded the bound."""
+
+
+def assert_soak_bars(report: dict, max_recovery_s: float) -> None:
+    """The chaos-gate acceptance bars (CI soak-smoke fails on these):
+    zero client-visible 5xx/transport errors end-to-end, every SCHEDULED
+    fault actually injected (a failed or dropped injection must not turn
+    the gate green by injecting no chaos at all), and every injected
+    fault recovered within ``max_recovery_s``."""
+    if not report["zero_5xx"]:
+        raise SoakViolation(
+            f"zero-5xx bar violated: {report['totals']['status_5xx']} 5xx, "
+            f"{report['totals']['errors']} errors "
+            f"(sheds excluded: {report['totals']['shed']})"
+        )
+    if report["faults_scheduled"] > len(report["faults"]):
+        raise SoakViolation(
+            f"only {len(report['faults'])} of {report['faults_scheduled']} "
+            f"scheduled faults fired — shorten the schedule or lengthen "
+            f"the ladder; a gate without its chaos proves nothing"
+        )
+    for f in report["faults"]:
+        if not f["ok"]:
+            raise SoakViolation(
+                f"fault {f['action']} at {f['at_s']}s FAILED to inject: "
+                f"{f.get('error')}"
+            )
+        if not f.get("skipped") and not f["recovery_ok"]:
+            raise SoakViolation(
+                f"fault {f['action']} at {f['at_s']}s did not recover "
+                f"within {max_recovery_s}s (measured: {f['recovery_s']})"
+            )
+
+
+# --------------------------------------------------------------- the ladder
+def _rung_workloads(base_url: str, model: str,
+                    classes: Sequence[SLOClass], qps: float,
+                    duration_s: float, rung_idx: int,
+                    max_users_per_class: int = 64) -> Tuple[List, List[str]]:
+    """WorkloadConfigs for one rung plus the classes whose session count
+    hit ``max_users_per_class``. Each class launches sessions at its
+    share of the rung QPS for the whole duration (the reference sweep
+    contract — arrivals keep coming, so overload is reachable), each
+    session running ``rounds`` rounds, hard-stopped at the rung bound.
+    When the cap binds, arrivals stop early and the tail of the rung runs
+    at decaying load — the rung records it (``capped_classes``; no silent
+    caps)."""
+    from benchmarks.multi_round_qa import WorkloadConfig
+
+    cfgs = []
+    capped = []
+    for cls in classes:
+        class_qps = max(qps * cls.share, 1e-3)
+        wanted = max(1, int(math.ceil(class_qps * duration_s)))
+        users = min(max_users_per_class, wanted)
+        if users < wanted:
+            capped.append(cls.name)
+        cfgs.append(WorkloadConfig(
+            base_url=base_url, model=model,
+            num_users=users, num_rounds=cls.rounds,
+            system_prompt_words=60,
+            question_words=cls.question_words,
+            answer_tokens=cls.answer_tokens,
+            qps=class_qps, time_limit_s=duration_s,
+            extra_headers=cls.headers(),
+            honor_retry_after=True, raise_on_error=False,
+            slo_class=cls.name,
+            tag=f"soak-r{rung_idx}-{cls.name}",
+        ))
+    return cfgs, capped
+
+
+async def _chaos_task(faults: Sequence[Fault], t0: float,
+                      executor: Callable, log: List[dict],
+                      stop: asyncio.Event) -> None:
+    """Execute the schedule at its offsets from ``t0``; every outcome is
+    appended to ``log`` (failures recorded, never raised — the soak's
+    verdict comes from the traffic, not the injector). ``stop`` ends the
+    schedule BETWEEN faults: an in-flight fault (e.g. an engine restart
+    running in a worker thread) always completes and is logged — a
+    mid-restart cancellation would abandon the thread to race the stack
+    teardown and silently drop the fault from the report."""
+    for fault in faults:
+        delay = t0 + fault.at_s - time.monotonic()
+        if delay > 0:
+            try:
+                await asyncio.wait_for(stop.wait(), timeout=delay)
+                return          # ladder ended before this fault was due
+            except asyncio.TimeoutError:
+                pass            # due now
+        elif stop.is_set():
+            return
+        entry = {
+            "action": fault.action,
+            "engine": fault.engine,
+            "at_s": round(fault.at_s, 3),
+            "injected_at": time.monotonic(),
+        }
+        try:
+            info = await executor(fault)
+            entry["ok"] = True
+            entry.update(info or {})
+        except Exception as e:  # noqa: BLE001 — recorded in the fault log
+            entry["ok"] = False
+            entry["error"] = repr(e)
+        log.append(entry)
+
+
+async def run_ladder(base_url: str, model: str,
+                     classes: Sequence[SLOClass],
+                     ladder: Sequence[float], rung_duration_s: float,
+                     faults: Sequence[Fault] = (),
+                     fault_executor: Optional[Callable] = None,
+                     recovery_window_s: float = 5.0,
+                     recovery_threshold: float = 0.9,
+                     max_recovery_s: float = 120.0,
+                     max_users_per_class: int = 64,
+                     ) -> Tuple[List[dict], List[dict], list]:
+    """Drive the QPS ladder with the chaos schedule running alongside.
+    Returns (rung summaries, fault log, all records). Transport-agnostic:
+    bench.py binds it to the subprocess stack, tests to an in-process
+    router over fake engines."""
+    from benchmarks.multi_round_qa import run_workload
+
+    t0 = time.monotonic()
+    fault_log: List[dict] = []
+    chaos = None
+    chaos_stop = asyncio.Event()
+    if faults and fault_executor is not None:
+        chaos = asyncio.create_task(
+            _chaos_task(faults, t0, fault_executor, fault_log, chaos_stop)
+        )
+    all_records: list = []
+    rungs: List[dict] = []
+    try:
+        for idx, qps in enumerate(ladder):
+            cfgs, capped = _rung_workloads(base_url, model, classes, qps,
+                                           rung_duration_s, idx,
+                                           max_users_per_class)
+            if capped:
+                print(f"soak rung {idx} (qps {qps}): session count capped "
+                      f"at {max_users_per_class} for {', '.join(capped)} — "
+                      f"arrivals stop early, tail load decays",
+                      file=sys.stderr)
+            rung_start = time.monotonic()
+            per_class = await asyncio.gather(
+                *[run_workload(cfg) for cfg in cfgs]
+            )
+            rung_elapsed = time.monotonic() - rung_start
+            rung = {
+                "qps": qps,
+                "duration_s": round(rung_elapsed, 3),
+                "users": {cls.name: cfg.num_users
+                          for cls, cfg in zip(classes, cfgs)},
+                "capped_classes": capped,
+                "classes": {
+                    cls.name: class_summary(recs, cls, rung_elapsed)
+                    for cls, recs in zip(classes, per_class)
+                },
+            }
+            rungs.append(rung)
+            for recs in per_class:
+                all_records.extend(recs)
+    finally:
+        if chaos is not None:
+            # The ladder is done: faults scheduled beyond it never fire,
+            # but an IN-FLIGHT fault finishes and gets logged (its worker
+            # thread must not race the stack teardown). The timeout
+            # outlasts the bounded restart health wait; only a truly
+            # wedged executor gets cancelled.
+            chaos_stop.set()
+            try:
+                await asyncio.wait_for(chaos, timeout=360.0)
+            except asyncio.TimeoutError:
+                chaos.cancel()
+                try:
+                    await chaos
+                except asyncio.CancelledError:
+                    pass
+    for entry in fault_log:
+        rec = recovery_time(
+            all_records, entry["injected_at"], classes,
+            window_s=recovery_window_s, threshold=recovery_threshold,
+            horizon_s=max_recovery_s + recovery_window_s,
+        )
+        entry["recovery_s"] = None if rec is None else round(rec, 3)
+        entry["recovery_ok"] = rec is not None and rec <= max_recovery_s
+        entry.pop("injected_at", None)
+    return rungs, fault_log, all_records
+
+
+# --------------------------------------------------- stack-backed execution
+def _scrape_text(url: str) -> str:
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.read().decode("utf-8", "replace")
+
+
+def parse_autoscaler_gauges(metrics_text: str) -> Dict[str, bool]:
+    """Which autoscaler gauges are live (a samples line, not just # HELP)."""
+    present = dict.fromkeys(AUTOSCALER_GAUGES, False)
+    for line in metrics_text.splitlines():
+        if line.startswith("#"):
+            continue
+        name = line.split("{")[0].split(" ")[0]
+        if name in present:
+            present[name] = True
+    return present
+
+
+def parse_slo_attainment(metrics_text: str) -> Dict[str, float]:
+    """router_slo_attainment{slo_class="..."} values from exposition text."""
+    import re
+
+    out = {}
+    for line in metrics_text.splitlines():
+        if not line.startswith("router_slo_attainment{"):
+            continue
+        m = re.search(r'slo_class="([^"]+)"', line)
+        if m:
+            try:
+                out[m.group(1)] = float(line.rsplit(" ", 1)[1])
+            except ValueError:
+                continue
+    return out
+
+
+def _post_fault(engine_url: str, payload: dict) -> dict:
+    """POST /fault to an engine (fake engines serve it; real engines 404 —
+    recorded as skipped, the schedule keeps going)."""
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"{engine_url}/fault", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            resp.read()
+        return {"skipped": False}
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return {"skipped": True,
+                    "reason": "engine does not serve /fault"}
+        raise
+
+
+def make_stack_executor(stack, kv_handle=None) -> Callable:
+    """Chaos executor bound to the subprocess stack: restarts run in a
+    worker thread (they block on process exit + /health) so the event
+    loop keeps relaying soak traffic throughout."""
+
+    async def execute(fault: Fault) -> dict:
+        if fault.action == "restart_engine":
+            # Bounded health wait: a pod that cannot come back is a fault
+            # log entry (and a failed recovery bar), not a hung soak.
+            downtime = await asyncio.to_thread(
+                stack.restart_engine, fault.engine, 300.0
+            )
+            return {"downtime_s": round(downtime, 3)}
+        if fault.action == "restart_kv_server":
+            if kv_handle is None:
+                return {"skipped": True, "reason": "no kv server in stack"}
+            downtime = await asyncio.to_thread(kv_handle.restart)
+            return {"downtime_s": round(downtime, 3)}
+        if fault.action == "degrade_engine":
+            payload = {"action": "straggler",
+                       "itl": fault.params.get("itl", 0.05),
+                       "jitter": fault.params.get("jitter", 0.02)}
+            return await asyncio.to_thread(
+                _post_fault, stack.engine_urls[fault.engine], payload
+            )
+        if fault.action == "heal_engine":
+            return await asyncio.to_thread(
+                _post_fault, stack.engine_urls[fault.engine],
+                {"action": "heal"},
+            )
+        raise ValueError(f"unknown fault action {fault.action!r}")
+
+    return execute
+
+
+def run_soak(args) -> dict:
+    """bench.py --soak entry point: bring up the stack (N engines + router
+    + kv-offload server), run the ladder with the chaos schedule, scrape
+    the router's autoscaler gauges, and return the validated report."""
+    from benchmarks.multi_round_qa import WorkloadConfig, run_workload
+    from benchmarks.stack import launch_kv_server_handle, launch_stack
+
+    on_tpu = args.backend not in ("", "cpu")
+    if args.soak_classes:
+        classes = parse_classes(args.soak_classes)
+    else:
+        classes = default_classes(on_tpu)
+    ladder = [float(x) for x in str(args.soak_qps_ladder).split(",") if x]
+    if not ladder:
+        raise ValueError("--soak-qps-ladder must name at least one rung")
+    faults = parse_fault_schedule(args.soak_fault_schedule) \
+        if args.soak_fault_schedule else ()
+
+    kv_handle = launch_kv_server_handle()
+    stack = None
+    try:
+        stack = launch_stack(
+            args.model,
+            engine_args=[
+                "--max-model-len", str(args.max_model_len),
+                "--max-num-seqs", "16",
+                "--attn-impl", args.attn_impl,
+                "--kv-cache-dtype", args.kv_cache_dtype,
+                "--max-queue-len", str(args.soak_max_queue_len),
+                *(["--no-warmup"] if not on_tpu else []),
+            ],
+            engine_env={"LMCACHE_REMOTE_URL": kv_handle.url},
+            routing_logic="session",
+            router_args=[
+                "--session-key", "x-user-id",
+                "--breaker-half-open-dwell", "2.0",
+            ],
+            num_engines=args.num_engines,
+        )
+        # Warmup: compile every measured shape before the ladder starts
+        # (BENCH_r04's cold-compile lesson).
+        for cls in classes:
+            warm = WorkloadConfig(
+                base_url=stack.router_url, model=args.model,
+                num_users=2, num_rounds=1, system_prompt_words=60,
+                answer_tokens=cls.answer_tokens, tag=f"warmup-{cls.name}",
+                extra_headers=cls.headers(), slo_class=cls.name,
+                honor_retry_after=True, raise_on_error=False,
+            )
+            asyncio.run(run_workload(warm))
+
+        rungs, fault_log, _records = asyncio.run(run_ladder(
+            stack.router_url, args.model, classes, ladder,
+            args.soak_rung_duration,
+            faults=faults,
+            fault_executor=make_stack_executor(stack, kv_handle),
+            max_recovery_s=args.soak_max_recovery,
+        ))
+        metrics_text = _scrape_text(f"{stack.router_url}/metrics")
+    finally:
+        if stack is not None:
+            stack.terminate()
+        kv_handle.terminate()
+
+    return build_report(
+        model=args.model, backend=args.backend,
+        num_engines=args.num_engines, classes=classes,
+        rungs=rungs, faults=fault_log, faults_scheduled=len(faults),
+        autoscaler_gauges=parse_autoscaler_gauges(metrics_text),
+        slo_attainment_gauge=parse_slo_attainment(metrics_text),
+    )

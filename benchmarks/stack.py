@@ -39,28 +39,82 @@ def wait_tcp(host: str, port: int, timeout_s: float, proc: subprocess.Popen,
                        f"after {timeout_s}s")
 
 
+@dataclass
+class KVServerHandle:
+    """Restartable cache-server subprocess (soak chaos: restart_kv_server).
+    The port is pinned so LMCACHE_REMOTE_URL stays valid across restarts —
+    engines reconnect via RemoteKVClient's one-shot retry."""
+
+    proc: subprocess.Popen
+    url: str
+    port: int
+    log_path: str
+    log_file: object
+    max_bytes: int
+
+    def _spawn(self) -> subprocess.Popen:
+        return subprocess.Popen(
+            [
+                sys.executable, "-m",
+                "production_stack_tpu.kv_offload.server",
+                "--force-python", "--host", "127.0.0.1",
+                "--port", str(self.port), "--max-bytes", str(self.max_bytes),
+            ],
+            stdout=self.log_file, stderr=subprocess.STDOUT,
+        )
+
+    def restart(self, timeout_s: float = 60.0) -> float:
+        """SIGTERM -> wait exit -> relaunch on the SAME port -> wait
+        listening. Returns the downtime in seconds."""
+        t0 = time.monotonic()
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=15)
+        self.proc = self._spawn()
+        wait_tcp("127.0.0.1", self.port, timeout_s, self.proc, "kv_server")
+        return time.monotonic() - t0
+
+    def terminate(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+        self.log_file.close()
+
+
 def launch_kv_server(max_bytes: int = 1 << 30, log_dir: str = "/tmp"):
     """Start the Python cache server as a subprocess; returns
-    (Popen, kv_url, log_path, log_file). The disagg bench mode's handoff
-    plane and the engines' LMCACHE_REMOTE_URL both point at it."""
+    (Popen, kv_url, log_path, log_file) — see also launch_kv_server_handle
+    for the restartable wrapper the soak harness drives. The disagg bench
+    mode's handoff plane and the engines' LMCACHE_REMOTE_URL both point
+    at it."""
+    h = launch_kv_server_handle(max_bytes=max_bytes, log_dir=log_dir)
+    return h.proc, h.url, h.log_path, h.log_file
+
+
+def launch_kv_server_handle(max_bytes: int = 1 << 30,
+                            log_dir: str = "/tmp") -> KVServerHandle:
     port = free_port()
     log = os.path.join(log_dir, f"pstpu-bench-kvserver-{port}.log")
     log_f = open(log, "w")
-    proc = subprocess.Popen(
-        [
-            sys.executable, "-m", "production_stack_tpu.kv_offload.server",
-            "--force-python", "--host", "127.0.0.1", "--port", str(port),
-            "--max-bytes", str(max_bytes),
-        ],
-        stdout=log_f, stderr=subprocess.STDOUT,
+    handle = KVServerHandle(
+        proc=None, url=f"kv://127.0.0.1:{port}", port=port,  # type: ignore
+        log_path=log, log_file=log_f, max_bytes=max_bytes,
     )
+    handle.proc = handle._spawn()
     try:
-        wait_tcp("127.0.0.1", port, 60.0, proc, "kv_server")
+        wait_tcp("127.0.0.1", port, 60.0, handle.proc, "kv_server")
     except Exception:
-        proc.kill()
+        handle.proc.kill()
         log_f.close()
         raise
-    return proc, f"kv://127.0.0.1:{port}", log, log_f
+    return handle
 
 
 def wait_health(url: str, timeout_s: float, proc: subprocess.Popen,
@@ -89,6 +143,11 @@ class StackHandle:
     router_url: str
     log_paths: List[str] = field(default_factory=list)
     log_files: List[object] = field(default_factory=list)
+    # Relaunch state (soak chaos: restart_engine): engine i's exact argv,
+    # its log file, and the env overrides it was launched with.
+    engine_cmds: List[List[str]] = field(default_factory=list)
+    engine_log_files: List[object] = field(default_factory=list)
+    engine_env: Optional[dict] = None
 
     @property
     def engine(self) -> subprocess.Popen:
@@ -98,6 +157,36 @@ class StackHandle:
     @property
     def engine_url(self) -> str:
         return self.engine_urls[0]
+
+    def restart_engine(self, index: int, startup_timeout_s: float = 1800.0,
+                       kill_timeout_s: float = 60.0) -> float:
+        """Rolling-restart engine ``index``: SIGTERM (graceful drain — the
+        engine finishes in-flight streams, sheds new work with
+        503+Retry-After, then exits), wait for exit, relaunch the same
+        argv/env on the same port, block until /health is 200 again.
+        Returns the measured downtime in seconds. Blocking by design: the
+        soak harness calls it via asyncio.to_thread so traffic keeps
+        flowing while the pod bounces."""
+        proc = self.engines[index]
+        t0 = time.monotonic()
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=kill_timeout_s)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=kill_timeout_s)
+        env = ({**os.environ, **self.engine_env}
+               if self.engine_env else None)
+        new = subprocess.Popen(
+            self.engine_cmds[index],
+            stdout=self.engine_log_files[index], stderr=subprocess.STDOUT,
+            env=env,
+        )
+        self.engines[index] = new
+        wait_health(f"{self.engine_urls[index]}/health", startup_timeout_s,
+                    new, f"engine {self.engine_urls[index]} (restarted)")
+        return time.monotonic() - t0
 
     def terminate(self) -> None:
         procs = [self.router, *self.engines]
@@ -141,6 +230,8 @@ def launch_stack(
 
     engines: List[subprocess.Popen] = []
     engine_urls: List[str] = []
+    engine_cmds: List[List[str]] = []
+    engine_log_files: List[object] = []
     log_paths: List[str] = []
     log_files: List[object] = []
     rlog_f = None
@@ -158,18 +249,21 @@ def launch_stack(
                 per_engine_args[i]
                 if per_engine_args and i < len(per_engine_args) else []
             )
+            cmd = [
+                sys.executable, "-m",
+                "production_stack_tpu.server.api_server",
+                "--model", model, "--port", str(engine_port),
+                *(engine_args or []),
+                *extra,
+            ]
             engines.append(subprocess.Popen(
-                [
-                    sys.executable, "-m",
-                    "production_stack_tpu.server.api_server",
-                    "--model", model, "--port", str(engine_port),
-                    *(engine_args or []),
-                    *extra,
-                ],
+                cmd,
                 stdout=elog_f, stderr=subprocess.STDOUT,
                 env=({**os.environ, **engine_env} if engine_env else None),
             ))
             engine_urls.append(engine_url)
+            engine_cmds.append(cmd)
+            engine_log_files.append(elog_f)
         for engine, engine_url in zip(engines, engine_urls):
             wait_health(f"{engine_url}/health", startup_timeout_s, engine,
                         f"engine {engine_url}")
@@ -203,4 +297,6 @@ def launch_stack(
     return StackHandle(
         engines=engines, router=router, engine_urls=engine_urls,
         router_url=router_url, log_paths=log_paths, log_files=log_files,
+        engine_cmds=engine_cmds, engine_log_files=engine_log_files,
+        engine_env=dict(engine_env) if engine_env else None,
     )

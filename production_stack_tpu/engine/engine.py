@@ -871,6 +871,11 @@ class ServingEngine:
                 self.runner.kv_quant_bytes_saved_total,
             "num_requests_running": self.scheduler.num_running,
             "num_requests_waiting": self.scheduler.num_waiting,
+            # Autoscaling signal (docs/SOAK.md): total backlog on this
+            # engine — the per-pod HPA metric.
+            "queue_depth": (
+                self.scheduler.num_running + self.scheduler.num_waiting
+            ),
             "kv_cache_usage": self.block_manager.usage(),
             "prefix_cache_hits": self.block_manager.prefix_hits_total,
             "prefix_cache_queries": self.block_manager.prefix_queries_total,

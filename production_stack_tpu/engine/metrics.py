@@ -47,6 +47,10 @@ class EngineMetricsCollector(Collector):
                     "Number of requests currently decoding", sched.num_running)
         yield gauge("vllm:num_requests_waiting",
                     "Number of requests waiting for prefill", sched.num_waiting)
+        yield gauge("pstpu:queue_depth",
+                    "Engine backlog (running + waiting requests) — the "
+                    "per-pod autoscaling signal (docs/SOAK.md)",
+                    sched.num_running + sched.num_waiting)
         yield gauge("vllm:gpu_cache_usage_perc",
                     "KV pool usage fraction (TPU HBM)", bm.usage())
         yield counter("vllm:gpu_prefix_cache_hits_total",

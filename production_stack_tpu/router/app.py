@@ -44,6 +44,7 @@ from production_stack_tpu.router.request_service import (
 from production_stack_tpu.router.resilience import (
     ResilienceConfig,
     get_resilience,
+    get_slo_tracker,
     initialize_resilience,
 )
 from production_stack_tpu.router.rewriter import get_request_rewriter
@@ -139,6 +140,12 @@ async def handle_health(request: web.Request) -> web.Response:
     return web.json_response(payload)
 
 
+# Autoscaler gauge label sets published on the last /metrics render, so
+# departed backends/roles can be removed from the registry (a prometheus
+# Gauge keeps serving a label set's last value until it is removed).
+_autoscale_published: dict = {"server": set(), "role": set()}
+
+
 async def handle_metrics(request: web.Request) -> web.Response:
     from prometheus_client import generate_latest, CONTENT_TYPE_LATEST
 
@@ -166,9 +173,57 @@ async def handle_metrics(request: web.Request) -> web.Response:
         metrics.avg_itl.labels(server=url).set(rs.avg_itl)
         metrics.num_requests_swapped.labels(server=url).set(
             rs.num_swapped_requests)
-    metrics.healthy_pods_total.labels(server="router").set(
-        len(get_service_discovery().get_endpoint_info())
-    )
+    endpoints = get_service_discovery().get_endpoint_info()
+    metrics.healthy_pods_total.labels(server="router").set(len(endpoints))
+    # Autoscaling signals (docs/SOAK.md): queue depth / KV pressure per
+    # backend from the scrape plane, plus mean in-flight depth per disagg
+    # role pool so prefill and decode pools can be sized independently.
+    pool_depth: dict = {}
+    pool_size: dict = {}
+    for ep in endpoints:
+        es = engine_stats.get(ep.url)
+        rs = request_stats.get(ep.url)
+        if es is not None:
+            depth = es.num_running_requests + es.num_queuing_requests
+        elif rs is not None:
+            # Engine not scraped yet: the router's own in-flight view.
+            depth = rs.in_prefill_requests + rs.in_decoding_requests
+        else:
+            depth = 0
+        metrics.router_queue_depth.labels(server=ep.url).set(depth)
+        metrics.router_kv_pressure.labels(server=ep.url).set(
+            es.gpu_cache_usage_perc if es is not None else 0.0
+        )
+        role = (getattr(ep, "role", "") or
+                (es.role if es is not None else "") or "unified")
+        pool_depth[role] = pool_depth.get(role, 0) + depth
+        pool_size[role] = pool_size.get(role, 0) + 1
+    for role, size in pool_size.items():
+        metrics.router_pool_utilization.labels(role=role).set(
+            pool_depth[role] / size
+        )
+    # Departed backends/roles must DROP their autoscaler series, not
+    # freeze at their last value: the HPA sums these (prom-adapter rule),
+    # so a dead pod's stale depth would inflate the scale signal forever.
+    live_servers = {ep.url for ep in endpoints}
+    for gone in _autoscale_published["server"] - live_servers:
+        for gauge in (metrics.router_queue_depth, metrics.router_kv_pressure):
+            try:
+                gauge.remove(gone)
+            except KeyError:
+                pass
+    _autoscale_published["server"] = live_servers
+    for gone in _autoscale_published["role"] - set(pool_size):
+        try:
+            metrics.router_pool_utilization.remove(gone)
+        except KeyError:
+            pass
+    _autoscale_published["role"] = set(pool_size)
+    tracker = get_slo_tracker()
+    if tracker is not None:
+        # Re-expire attainment windows so the gauge never freezes at the
+        # last observed value after a class's traffic stops.
+        tracker.publish()
     return web.Response(body=generate_latest(),
                         content_type=CONTENT_TYPE_LATEST.split(";")[0])
 
@@ -296,8 +351,10 @@ def initialize_all(app: web.Application, args) -> None:
         breaker_min_requests=getattr(args, "breaker_min_requests", 5),
         breaker_error_rate=getattr(args, "breaker_error_rate", 0.5),
         breaker_open_duration=getattr(args, "breaker_open_duration", 10.0),
+        breaker_half_open_dwell=getattr(args, "breaker_half_open_dwell", 0.0),
         default_timeout=getattr(args, "request_timeout", 300.0),
         default_ttft_deadline=getattr(args, "ttft_deadline", 0.0),
+        slo_window=getattr(args, "request_stats_window", 60.0),
     ))
     gates = initialize_feature_gates(args.feature_gates)
 

@@ -94,6 +94,31 @@ router_deadline_exceeded_total = Counter(
     "Requests aborted on a deadline (kind: ttft or total)",
     ["server", "kind"],
 )
+# Autoscaling signals (docs/SOAK.md): the first-class gauges an HPA /
+# prometheus-adapter pipeline targets, so helm autoscaling wiring is a
+# values-only change. Refreshed by the router's /metrics handler from the
+# scrape + request stats planes (router_slo_attainment is pushed by the
+# SLOTracker as outcomes arrive).
+router_queue_depth = Gauge(
+    "router_queue_depth",
+    "Engine-reported running+waiting requests per backend "
+    "(the queue-depth scale-up signal)", ["server"],
+)
+router_kv_pressure = Gauge(
+    "router_kv_pressure",
+    "KV-pool usage fraction per backend (HBM pressure; scale up before "
+    "eviction/preemption sets in)", ["server"],
+)
+router_pool_utilization = Gauge(
+    "router_pool_utilization",
+    "Mean in-flight depth per engine in each disagg role pool "
+    "(unified/prefill/decode) — sizes role pools independently", ["role"],
+)
+router_slo_attainment = Gauge(
+    "router_slo_attainment",
+    "Rolling-window fraction of x-slo-class requests meeting their soft "
+    "TTFT target (sheds and failures count as misses)", ["slo_class"],
+)
 # Prefill/decode disaggregation (docs/DISAGG.md): two-hop flow outcomes.
 router_disagg_handoffs_total = Counter(
     "router_disagg_handoffs",

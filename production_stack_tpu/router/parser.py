@@ -108,6 +108,11 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--breaker-open-duration", type=float, default=10.0,
                    help="seconds an open circuit waits before the half-open "
                         "probe")
+    p.add_argument("--breaker-half-open-dwell", type=float, default=0.0,
+                   help="minimum seconds of successful half-open probing "
+                        "before a breaker may close (hysteresis against "
+                        "open/closed flap on slow stragglers; 0 closes on "
+                        "the first probe success)")
     p.add_argument("--request-timeout", type=float, default=300.0,
                    help="default total per-request deadline in seconds "
                         "(0 disables; x-request-timeout header overrides)")

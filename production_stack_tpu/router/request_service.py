@@ -41,6 +41,7 @@ from production_stack_tpu.router.resilience import (
     ResilienceConfig,
     backoff_delay,
     get_resilience,
+    get_slo_tracker,
 )
 from production_stack_tpu.router.routing_logic import get_routing_logic
 from production_stack_tpu.router.service_discovery import get_service_discovery
@@ -89,6 +90,15 @@ def _error(status: int, message: str, etype: str = "invalid_request_error",
 def _resilience_config() -> ResilienceConfig:
     mgr = get_resilience()
     return mgr.config if mgr is not None else ResilienceConfig()
+
+
+def _slo_miss(headers) -> None:
+    """Record an SLO miss for requests the router could not serve (shed,
+    deadline abort, retry budget exhausted) — attainment must sag while
+    work is being turned away, or the autoscaler never sees overload."""
+    tracker = get_slo_tracker()
+    if tracker is not None:
+        tracker.observe_from_headers(headers, _resilience_config(), None)
 
 
 def _next_backend(endpoints, tried, resilience, request_like) -> Optional[str]:
@@ -186,6 +196,7 @@ async def route_general_request(
     tried: set = set()
     attempt = 0
     last_failure: Optional[PreStreamFailure] = None
+    all_attempts_shed = True   # ANDed over failures: every attempt a 503?
 
     import contextlib
 
@@ -195,6 +206,7 @@ async def route_general_request(
         attempt += 1
         backend_url = _next_backend(endpoints, tried, resilience, routed)
         if backend_url is None:
+            _slo_miss(request.headers)
             return _error(
                 503, "All backends unavailable (circuit open)",
                 etype="service_unavailable", headers={"Retry-After": "1"},
@@ -232,12 +244,14 @@ async def route_general_request(
             metrics.router_deadline_exceeded_total.labels(
                 server=e.backend_url, kind=e.kind
             ).inc()
+            _slo_miss(request.headers)
             return _error(
                 504, f"Request {e.kind} deadline exceeded",
                 etype="deadline_exceeded",
             )
         except PreStreamFailure as e:
             last_failure = e
+            all_attempts_shed = all_attempts_shed and e.status == 503
             if attempt >= max(1, cfg.retry_max_attempts):
                 break
             metrics.router_retries_total.labels(server=e.backend_url).inc()
@@ -247,12 +261,26 @@ async def route_general_request(
                 metrics.router_deadline_exceeded_total.labels(
                     server=e.backend_url, kind="total"
                 ).inc()
+                _slo_miss(request.headers)
                 return _error(
                     504, "Request total deadline exceeded",
                     etype="deadline_exceeded",
                 )
             await asyncio.sleep(delay)
 
+    _slo_miss(request.headers)
+    if last_failure is not None and all_attempts_shed:
+        # EVERY attempt ended on a backend 503 — the pool is SHEDDING
+        # (queue bound, drain), not broken. Propagate the shed semantics
+        # (503 + Retry-After) instead of masking them as a 502 so clients
+        # back off and retry rather than counting an error
+        # (docs/SOAK.md accounting). Any non-503 failure in the mix
+        # (connect refused, 502) means a genuinely broken backend and
+        # stays a 502 regardless of attempt order.
+        return _error(
+            503, f"All backends shedding after {attempt} attempt(s)",
+            etype="service_unavailable", headers={"Retry-After": "1"},
+        )
     return _error(
         502, f"Backend request failed after {attempt} attempt(s): "
              f"{last_failure}",
@@ -379,6 +407,17 @@ async def proxy_request(
         if backend_resp is not None and not backend_resp.closed:
             backend_resp.close()
         raise _fail(f"unexpected pre-stream failure: {e!r}") from e
+
+    # First byte secured: record the soft SLO outcome (x-slo-class /
+    # x-slo-ttft headers; relayed 5xx bodies count as misses even when
+    # their first byte was fast).
+    tracker = get_slo_tracker()
+    if tracker is not None and deadline is not None:
+        tracker.observe_from_headers(
+            request.headers, _resilience_config(),
+            None if backend_resp.status >= 500
+            else time.monotonic() - deadline.start,
+        )
 
     # From here on, bytes go to the client: failures are truncation-only.
     response = web.StreamResponse(

@@ -25,6 +25,7 @@ is truncation-only — the backend is marked, never the bytes resent.
 
 import random
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
@@ -52,11 +53,22 @@ class ResilienceConfig:
     breaker_min_requests: int = 5      # outcomes required before tripping
     breaker_error_rate: float = 0.5    # windowed error rate that opens
     breaker_open_duration: float = 10.0  # cooldown before the half-open probe
+    # Half-open hysteresis: minimum seconds a breaker must keep probing
+    # successfully before it may close. 0 closes on the first probe success
+    # (the pre-soak behavior), which lets a slow/jittery straggler flap
+    # open<->closed every probe under sustained load — a dwell makes the
+    # breaker demand a sustained healthy period instead.
+    breaker_half_open_dwell: float = 0.0
     # Deadlines (0 disables). Header overrides are per request.
     default_timeout: float = 300.0     # total request budget (seconds)
     default_ttft_deadline: float = 0.0  # budget to the first backend byte
     timeout_header: str = "x-request-timeout"
     ttft_header: str = "x-ttft-deadline"
+    # Soft SLO attainment tracking (router_slo_attainment): window over
+    # which the per-class attainment fraction is computed.
+    slo_window: float = 60.0
+    slo_class_header: str = "x-slo-class"
+    slo_ttft_header: str = "x-slo-ttft"
 
 
 class DeadlineExceeded(Exception):
@@ -158,6 +170,7 @@ class CircuitBreaker:
         self._outcomes: List = []      # (timestamp, ok) within the window
         self._opened_at = 0.0
         self._probe_at = 0.0           # when the half-open probe dispatched
+        self._half_open_since = 0.0    # when probing started (dwell clock)
         self._publish()
 
     def _publish(self) -> None:
@@ -181,6 +194,7 @@ class CircuitBreaker:
                 return False
             self.state = HALF_OPEN
             self._probe_at = 0.0
+            self._half_open_since = now
             self._publish()
             logger.info("Circuit %s: open -> half-open (probing)", self.url)
         # HALF_OPEN: one probe at a time. The probe slot is a LEASE, not a
@@ -197,6 +211,19 @@ class CircuitBreaker:
     def record_success(self) -> None:
         now = time.monotonic()
         if self.state == HALF_OPEN:
+            if now - self._half_open_since < self.cfg.breaker_half_open_dwell:
+                # Hysteresis: a single fast probe success must not flap a
+                # straggler's breaker straight back to closed. Stay
+                # half-open, but free the probe slot immediately so the
+                # next probe dispatches without waiting out open_duration.
+                self._probe_at = 0.0
+                logger.info(
+                    "Circuit %s: half-open probe ok, dwelling "
+                    "(%.2fs of %.2fs)", self.url,
+                    now - self._half_open_since,
+                    self.cfg.breaker_half_open_dwell,
+                )
+                return
             self.state = CLOSED
             self._outcomes = []
             self._probe_at = 0.0
@@ -270,16 +297,139 @@ class ResilienceManager:
         }
 
 
+class SLOTracker:
+    """Rolling-window per-class SLO attainment, exported as the
+    ``router_slo_attainment{slo_class}`` gauge — the per-class scale-up
+    signal an autoscaler pairs with ``router_queue_depth`` (docs/SOAK.md).
+
+    Requests opt in by carrying the ``x-slo-class`` header (class name)
+    and, optionally, ``x-slo-ttft`` (a SOFT router-observed TTFT target in
+    seconds — measured only, never enforced; hard deadlines stay on
+    ``x-ttft-deadline``). Sheds, deadline aborts, and backend failures all
+    count as misses: an autoscaler must see attainment sag while the
+    router is turning work away.
+
+    The class name is CLIENT-CONTROLLED, so live classes are capped at
+    ``max_classes``: a new name arriving at the cap evicts the
+    least-recently-observed class (its gauge series removed) instead of
+    minting unbounded Prometheus label series / tracker memory — and
+    instead of silently ignoring new names, which would let a flood of
+    junk classes permanently starve the real ones out of tracking (a
+    legitimate class always re-registers on its next request). observe()
+    runs on the streaming hot path (first byte of every opted-in
+    request), so the window is a deque with a running met-counter: O(1)
+    amortized per observation, never a rescan of the window."""
+
+    def __init__(self, window: float = 60.0, max_classes: int = 32):
+        self.window = window
+        self.max_classes = max_classes
+        # class -> [deque of (ts, met), met_count]
+        self._outcomes: Dict[str, list] = {}
+
+    def _expire(self, state, cutoff: float) -> None:
+        outcomes, _ = state
+        while outcomes and outcomes[0][0] < cutoff:
+            _, was_met = outcomes.popleft()
+            if was_met:
+                state[1] -= 1
+
+    def observe(self, slo_class: str, met: bool) -> None:
+        now = time.monotonic()
+        state = self._outcomes.get(slo_class)
+        if state is None:
+            if len(self._outcomes) >= self.max_classes:
+                # Cardinality bound on an untrusted header: evict the
+                # least-recently-observed class to make room.
+                # (A class drained empty by snapshot() sorts first.)
+                stale = min(
+                    self._outcomes,
+                    key=lambda c: (self._outcomes[c][0][-1][0]
+                                   if self._outcomes[c][0] else 0.0),
+                )
+                del self._outcomes[stale]
+                try:
+                    metrics.router_slo_attainment.remove(stale)
+                except KeyError:
+                    pass
+            state = self._outcomes[slo_class] = [deque(), 0]
+        state[0].append((now, bool(met)))
+        if met:
+            state[1] += 1
+        self._expire(state, now - self.window)
+        metrics.router_slo_attainment.labels(slo_class=slo_class).set(
+            state[1] / len(state[0])
+        )
+
+    def publish(self) -> None:
+        """Re-expire every class's window and republish its gauge; classes
+        whose outcomes have fully aged out are dropped (label series
+        removed). Without this the gauge would freeze at its last value
+        once a class's traffic stops — e.g. pinned at 0.0 after a shed
+        burst ended the load — and an HPA wired to it would scale on stale
+        data forever. Called from the router's /metrics handler."""
+        cutoff = time.monotonic() - self.window
+        for cls in list(self._outcomes):
+            state = self._outcomes[cls]
+            self._expire(state, cutoff)
+            if not state[0]:
+                del self._outcomes[cls]
+                try:
+                    metrics.router_slo_attainment.remove(cls)
+                except KeyError:
+                    pass
+            else:
+                metrics.router_slo_attainment.labels(slo_class=cls).set(
+                    state[1] / len(state[0])
+                )
+
+    def observe_from_headers(self, headers, cfg: "ResilienceConfig",
+                             ttft_s: Optional[float]) -> None:
+        """Record one request outcome from its headers. ``ttft_s`` is the
+        router-observed TTFT, or None when no first byte was ever relayed
+        (shed / deadline / backend failure -> miss)."""
+        if headers is None:
+            return
+        slo_class = headers.get(cfg.slo_class_header)
+        if not slo_class:
+            return
+        target_raw = headers.get(cfg.slo_ttft_header)
+        if ttft_s is None:
+            met = False
+        elif target_raw is None:
+            met = True                 # class tracked, no TTFT target set
+        else:
+            try:
+                met = ttft_s <= float(target_raw)
+            except (TypeError, ValueError):
+                met = True
+        self.observe(slo_class, met)
+
+    def snapshot(self) -> Dict[str, float]:
+        cutoff = time.monotonic() - self.window
+        out = {}
+        for cls, state in self._outcomes.items():
+            self._expire(state, cutoff)
+            if state[0]:
+                out[cls] = state[1] / len(state[0])
+        return out
+
+
 _resilience: Optional[ResilienceManager] = None
+_slo_tracker: Optional[SLOTracker] = None
 
 
 def initialize_resilience(
     config: Optional[ResilienceConfig] = None,
 ) -> ResilienceManager:
-    global _resilience
+    global _resilience, _slo_tracker
     _resilience = ResilienceManager(config)
+    _slo_tracker = SLOTracker(window=_resilience.config.slo_window)
     return _resilience
 
 
 def get_resilience() -> Optional[ResilienceManager]:
     return _resilience
+
+
+def get_slo_tracker() -> Optional[SLOTracker]:
+    return _slo_tracker

@@ -23,7 +23,7 @@ def render_engine_metrics(engine: "ServingEngine", model_name: str) -> str:
                 "kv_handoffs_total", "kv_handoff_bytes_total",
                 "kv_handoff_seconds_total", "kv_handoff_failures_total",
                 "engine_uptime_seconds", "kv_offload_blocks",
-                "kv_quant_bytes_saved_total"):
+                "kv_quant_bytes_saved_total", "queue_depth"):
         s.setdefault(key, 0)
     s.setdefault("disagg_role", "unified")
     s.setdefault("kv_cache_dtype", "bfloat16")
@@ -35,6 +35,13 @@ def render_engine_metrics(engine: "ServingEngine", model_name: str) -> str:
         "# HELP vllm:num_requests_waiting Waiting requests",
         "# TYPE vllm:num_requests_waiting gauge",
         f"vllm:num_requests_waiting{label} {s['num_requests_waiting']}",
+        # Autoscaling signal (docs/SOAK.md): running+waiting backlog as one
+        # per-pod series, the Pods-type HPA metric (prometheus-adapter
+        # exposes it as pstpu_queue_depth).
+        "# HELP pstpu:queue_depth Engine backlog (running + waiting "
+        "requests)",
+        "# TYPE pstpu:queue_depth gauge",
+        f"pstpu:queue_depth{label} {s['queue_depth']}",
         "# HELP vllm:gpu_cache_usage_perc KV-pool usage (TPU HBM)",
         "# TYPE vllm:gpu_cache_usage_perc gauge",
         f"vllm:gpu_cache_usage_perc{label} {s['kv_cache_usage']:.6f}",

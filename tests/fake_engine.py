@@ -14,11 +14,24 @@ Fault-injection modes (tests/test_resilience.py harness):
   * ``die_after_chunks = N`` — stream N SSE chunks then kill the
     connection, the mid-stream failure class;
   * ``extra_latency = T`` — hang T seconds before the first byte, for
-    deadline tests.
+    deadline tests;
+  * ``extra_latency_jitter = J`` — add uniform(0, J) seconds per request
+    on top of extra_latency (tail-latency realism for soak tests);
+  * ``set_straggler(itl, jitter)`` — slow/jittery straggler: every
+    streamed chunk takes an extra itl + uniform(0, jitter) seconds, the
+    degraded-but-alive pod class the soak chaos schedule exercises.
+
+Faults are also injectable over HTTP via ``POST /fault`` (the soak
+harness's chaos executor drives engines cross-process with it):
+    {"action": "straggler", "itl": 0.05, "jitter": 0.02}
+    {"action": "latency", "extra": 0.5, "jitter": 0.1}
+    {"action": "fail_for", "seconds": 2.0, "status": 503}
+    {"action": "heal"}
 """
 
 import asyncio
 import json
+import random
 import time
 
 from aiohttp import web
@@ -44,6 +57,9 @@ class FakeEngine:
         self.refuse_connections = False  # kill the transport pre-response
         self.die_after_chunks = None     # kill the transport mid-stream
         self.extra_latency = 0.0         # hang before the first byte
+        self.extra_latency_jitter = 0.0  # + uniform(0, J) per request
+        self.straggler_itl = 0.0         # extra seconds per streamed chunk
+        self.straggler_jitter = 0.0      # + uniform(0, J) per chunk
         self.faults_served = 0           # how many requests hit a fault
 
     def fail_for(self, seconds: float, status: int = 503) -> None:
@@ -51,12 +67,23 @@ class FakeEngine:
         self.unavailable_until = time.time() + seconds
         self.unavailable_status = status
 
+    def set_straggler(self, itl: float, jitter: float = 0.0) -> None:
+        """Degrade to a slow/jittery straggler: every streamed chunk takes
+        an extra ``itl + uniform(0, jitter)`` seconds. The pod stays alive
+        and healthy-looking — exactly the fault class that flaps a breaker
+        without half-open hysteresis."""
+        self.straggler_itl = itl
+        self.straggler_jitter = jitter
+
     def heal(self) -> None:
         """Clear every injected fault."""
         self.unavailable_until = 0.0
         self.refuse_connections = False
         self.die_after_chunks = None
         self.extra_latency = 0.0
+        self.extra_latency_jitter = 0.0
+        self.straggler_itl = 0.0
+        self.straggler_jitter = 0.0
 
     def build_app(self) -> web.Application:
         app = web.Application()
@@ -65,7 +92,31 @@ class FakeEngine:
         app.router.add_get("/v1/models", self.models)
         app.router.add_get("/health", self.health)
         app.router.add_get("/metrics", self.metrics)
+        app.router.add_post("/fault", self.fault)
         return app
+
+    async def fault(self, request):
+        """Cross-process fault injection (soak chaos executor). Real
+        engines do not serve /fault — the executor treats a 404 there as
+        'degrade unsupported' and records the fault as skipped."""
+        body = json.loads(await request.read())
+        action = body.get("action")
+        if action == "heal":
+            self.heal()
+        elif action == "straggler":
+            self.set_straggler(float(body.get("itl", 0.05)),
+                               float(body.get("jitter", 0.0)))
+        elif action == "latency":
+            self.extra_latency = float(body.get("extra", 0.0))
+            self.extra_latency_jitter = float(body.get("jitter", 0.0))
+        elif action == "fail_for":
+            self.fail_for(float(body.get("seconds", 1.0)),
+                          int(body.get("status", 503)))
+        else:
+            return web.json_response(
+                {"error": f"unknown fault action {action!r}"}, status=400
+            )
+        return web.json_response({"status": "ok", "action": action})
 
     async def models(self, request):
         return web.json_response({
@@ -118,8 +169,11 @@ class FakeEngine:
         stream = bool(body.get("stream", False))
         self.running += 1
         try:
-            if self.extra_latency:
-                await asyncio.sleep(self.extra_latency)
+            if self.extra_latency or self.extra_latency_jitter:
+                await asyncio.sleep(
+                    self.extra_latency
+                    + random.uniform(0, self.extra_latency_jitter)
+                )
             if self.ttft:
                 await asyncio.sleep(self.ttft)
             if not stream:
@@ -170,6 +224,11 @@ class FakeEngine:
                 await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
                 if self.speed:
                     await asyncio.sleep(1.0 / self.speed)
+                if self.straggler_itl or self.straggler_jitter:
+                    await asyncio.sleep(
+                        self.straggler_itl
+                        + random.uniform(0, self.straggler_jitter)
+                    )
             await resp.write(b"data: [DONE]\n\n")
             await resp.write_eof()
             return resp

@@ -154,6 +154,65 @@ def test_pvc_and_secret_render():
     assert "--no-enable-prefix-caching" in args
 
 
+def test_hpa_stanza_targets_autoscaler_gauges():
+    """values-07: the HPA wiring over the soak harness's signal exports
+    (docs/SOAK.md) — engine pools scale on the pods metric backed by
+    pstpu:queue_depth, the router tier on the router_queue_depth Object
+    metric, and it is all a values-only change."""
+    values_07 = next(p for p in EXAMPLES if "autoscaling" in p)
+    manifests = render_chart(CHART, values_file=values_07,
+                             release_name="stack")
+    hpas = _by_kind(manifests, "HorizontalPodAutoscaler")
+    assert len(hpas) == 2
+    engine_hpa = next(
+        h for h in hpas if h["metadata"]["name"].endswith("hpa-engine")
+    )
+    assert engine_hpa["spec"]["scaleTargetRef"]["name"] \
+        == "stack-llama1b-deployment-engine"
+    assert engine_hpa["spec"]["minReplicas"] == 2
+    assert engine_hpa["spec"]["maxReplicas"] == 8
+    metric = engine_hpa["spec"]["metrics"][0]
+    assert metric["type"] == "Pods"
+    # pstpu:queue_depth under the prometheus-adapter's ':'-stripped name.
+    assert metric["pods"]["metric"]["name"] == "pstpu_queue_depth"
+    assert metric["pods"]["target"]["averageValue"] == "8"
+
+    router_hpa = next(
+        h for h in hpas if h["metadata"]["name"].endswith("hpa-router")
+    )
+    assert router_hpa["spec"]["scaleTargetRef"]["name"] \
+        == "stack-deployment-router"
+    metric = router_hpa["spec"]["metrics"][0]
+    assert metric["type"] == "Object"
+    assert metric["object"]["metric"]["name"] == "router_queue_depth"
+    assert metric["object"]["describedObject"]["name"] \
+        == "stack-router-service"
+
+
+def test_hpa_disabled_by_default():
+    manifests = render_chart(CHART, values_file=EXAMPLES[0],
+                             release_name="stack")
+    assert not _by_kind(manifests, "HorizontalPodAutoscaler")
+
+
+@pytest.mark.parametrize("values_file", EXAMPLES,
+                         ids=[os.path.basename(p) for p in EXAMPLES])
+def test_example_values_satisfy_schema(values_file):
+    """Every example values file validates against values.schema.json —
+    the schema is the contract operators' CI lints their overrides with,
+    so it must keep up with new stanzas (autoscaling, roles, tpuConfig)."""
+    jsonschema = pytest.importorskip("jsonschema")
+    import yaml
+
+    with open(os.path.join(CHART, "values.schema.json")) as f:
+        import json
+
+        schema = json.load(f)
+    with open(values_file) as f:
+        values = yaml.safe_load(f)
+    jsonschema.validate(values, schema)
+
+
 def test_rbac_for_discovery():
     manifests = render_chart(CHART, values_file=EXAMPLES[0],
                              release_name="stack")

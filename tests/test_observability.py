@@ -48,17 +48,17 @@ def _exported_series():
             }
 
     text = render_engine_metrics(_FakeEngine(), "m")
-    series = set(re.findall(r"^(vllm:[a-z_]+)", text, re.M))
+    series = set(re.findall(r"^((?:vllm|pstpu):[a-z_]+)", text, re.M))
     # Router series from its gauge registry.
     from production_stack_tpu.router import metrics as router_metrics
 
     src = open(router_metrics.__file__).read()
-    series |= set(re.findall(r'"(vllm:[a-z_]+)"', src))
+    series |= set(re.findall(r'"((?:vllm:|pstpu:|router_)[a-z_]+)"', src))
     return series
 
 
 def _metric_names(expr):
-    return set(re.findall(r"(vllm:[a-z_]+)", expr))
+    return set(re.findall(r"((?:vllm:|pstpu:|router_)[a-z_]+)", expr))
 
 
 def test_dashboard_queries_name_exported_series():
@@ -84,11 +84,18 @@ def test_prom_adapter_rule_names_exported_series():
         cfg = yaml.safe_load(f)
     exported = _exported_series()
     rules = cfg["rules"]["custom"]
-    assert rules
+    assert len(rules) >= 3   # legacy waiting gauge + the autoscaler pair
     for rule in rules:
         series = _metric_names(rule["seriesQuery"])
-        assert series <= exported
-        assert rule["name"]["as"] == "vllm_num_requests_waiting"
+        assert len(series) == 1, rule["seriesQuery"]
+        assert series <= exported, (series, sorted(exported))
+        # Adapter naming convention: the Prometheus series with ':'
+        # replaced (k8s metric names cannot carry colons).
+        assert rule["name"]["as"] == series.pop().replace(":", "_")
+    # The helm HPA stanzas' default metric names must be servable by
+    # these rules (docs/SOAK.md: values-only autoscaling wiring).
+    served = {r["name"]["as"] for r in rules}
+    assert {"pstpu_queue_depth", "router_queue_depth"} <= served
 
 
 def test_latency_histograms_scrape():

@@ -318,6 +318,166 @@ def test_circuit_breaker_state_machine():
     assert br.state == CLOSED and br.allow()
 
 
+def test_breaker_half_open_dwell_prevents_flap():
+    """With a dwell, a single fast probe success must NOT close the
+    breaker (the slow-straggler soak flap): the breaker keeps probing
+    until it has been healthy for the whole dwell, and a failure anywhere
+    in the dwell re-opens without ever having reported closed."""
+    cfg = ResilienceConfig(
+        breaker_window=10.0, breaker_min_requests=2, breaker_error_rate=0.5,
+        breaker_open_duration=0.05, breaker_half_open_dwell=0.2,
+    )
+    br = CircuitBreaker("http://e1", cfg)
+    br.record_failure()
+    br.record_failure()
+    assert br.state == OPEN
+
+    # Cooldown -> half-open; a probe success inside the dwell keeps it
+    # half-open AND frees the probe slot immediately (no open_duration
+    # wait between dwell probes).
+    time.sleep(0.06)
+    assert br.allow()
+    br.on_dispatch()
+    br.record_success()
+    assert br.state == HALF_OPEN
+    assert br.allow()               # next probe dispatches right away
+
+    # A failure mid-dwell re-opens; the breaker never reported closed.
+    br.on_dispatch()
+    br.record_failure()
+    assert br.state == OPEN
+
+    # Second cycle: sustained success through the dwell finally closes.
+    time.sleep(0.06)
+    assert br.allow()
+    br.on_dispatch()
+    br.record_success()
+    assert br.state == HALF_OPEN
+    deadline = time.monotonic() + 2.0
+    while br.state == HALF_OPEN and time.monotonic() < deadline:
+        if br.allow():
+            br.on_dispatch()
+            br.record_success()
+        time.sleep(0.02)
+    assert br.state == CLOSED and br.allow()
+
+
+def test_breaker_dwell_zero_keeps_first_probe_close():
+    """Default dwell=0 preserves the original semantics: the first probe
+    success closes the circuit."""
+    cfg = ResilienceConfig(
+        breaker_window=10.0, breaker_min_requests=2, breaker_error_rate=0.5,
+        breaker_open_duration=0.05,
+    )
+    br = CircuitBreaker("http://e1", cfg)
+    br.record_failure()
+    br.record_failure()
+    time.sleep(0.06)
+    assert br.allow()
+    br.on_dispatch()
+    br.record_success()
+    assert br.state == CLOSED
+
+
+# --------------------------------------------------------------------------
+# SLO attainment tracking (router_slo_attainment)
+# --------------------------------------------------------------------------
+def test_slo_tracker_windowed_attainment():
+    from production_stack_tpu.router.resilience import SLOTracker
+
+    tr = SLOTracker(window=60.0)
+    for _ in range(3):
+        tr.observe("interactive", True)
+    tr.observe("interactive", False)
+    tr.observe("batch", True)
+    snap = tr.snapshot()
+    assert snap["interactive"] == 0.75
+    assert snap["batch"] == 1.0
+
+    # Header-driven observation: soft target met / missed / untargeted.
+    cfg = ResilienceConfig()
+    tr.observe_from_headers({"x-slo-class": "batch", "x-slo-ttft": "0.5"},
+                            cfg, ttft_s=0.4)
+    tr.observe_from_headers({"x-slo-class": "batch", "x-slo-ttft": "0.5"},
+                            cfg, ttft_s=0.9)
+    tr.observe_from_headers({"x-slo-class": "batch"}, cfg, ttft_s=9.9)
+    tr.observe_from_headers({}, cfg, ttft_s=0.1)          # no class: ignored
+    assert tr.snapshot()["batch"] == 0.75                 # 3 of 4 met
+
+
+def test_slo_tracker_bounds_class_cardinality():
+    """x-slo-class is client-controlled: live classes are capped (LRU
+    eviction), so junk names can neither mint unbounded gauge series nor
+    permanently starve the real classes out of tracking."""
+    from production_stack_tpu.router.resilience import SLOTracker
+
+    tr = SLOTracker(window=60.0, max_classes=4)
+    for i in range(50):
+        tr.observe(f"junk-{i}", True)
+    assert len(tr._outcomes) == 4      # never more than the cap alive
+    # A REAL class arriving after the flood still gets tracked — it
+    # evicts the least-recently-observed junk class.
+    tr.observe("interactive", True)
+    tr.observe("interactive", False)
+    assert tr.snapshot()["interactive"] == 0.5
+    assert len(tr._outcomes) == 4
+
+
+def test_slo_tracker_publish_expires_stale_classes():
+    """The gauge must not freeze at its last value after a class's
+    traffic stops: publish() re-expires windows and drops dead classes
+    (label series removed) — called from the router's /metrics render."""
+    from production_stack_tpu.router import metrics
+    from production_stack_tpu.router.resilience import SLOTracker
+
+    tr = SLOTracker(window=0.05)
+    tr.observe("burst", False)         # ends on a miss: gauge pinned at 0
+    assert ("burst",) in metrics.router_slo_attainment._metrics
+    time.sleep(0.08)
+    tr.publish()
+    assert "burst" not in tr._outcomes
+    assert ("burst",) not in metrics.router_slo_attainment._metrics
+    tr.publish()                       # idempotent on an empty tracker
+
+
+async def test_slo_attainment_exported_end_to_end():
+    """Requests carrying x-slo-class feed router_slo_attainment: fast
+    responses meet the soft target, a shed (all circuits open) counts as
+    a miss, and the gauge renders on /metrics."""
+    engines, servers, urls, client = await _start_stack(
+        n_engines=1, breaker_min_requests=2, breaker_error_rate=0.1,
+        breaker_open_duration=60.0, retry_max_attempts=2,
+    )
+    try:
+        hdrs = {"x-slo-class": "interactive", "x-slo-ttft": "5.0"}
+        for _ in range(3):
+            assert await _post_ok(client, headers=hdrs) == 200
+        from production_stack_tpu.router.resilience import get_slo_tracker
+
+        assert get_slo_tracker().snapshot()["interactive"] == 1.0
+
+        # Kill the backend; once its circuit opens the router sheds with
+        # 503 + Retry-After — an SLO miss for the class. (The first
+        # failing request may exhaust its retries as a 502 or already
+        # find the circuit open mid-retry: 503.)
+        engines[0].refuse_connections = True
+        assert await _post_ok(client, headers=hdrs) in (502, 503)
+        shed = await client.post("/v1/completions", json={
+            "model": "m1", "prompt": "x",
+        }, headers=hdrs)
+        assert shed.status == 503
+        snap = get_slo_tracker().snapshot()
+        assert snap["interactive"] < 1.0
+
+        text = await (await client.get("/metrics")).text()
+        assert 'router_slo_attainment{slo_class="interactive"}' in text
+        for series in ("router_queue_depth", "router_kv_pressure",
+                       "router_pool_utilization"):
+            assert series in text, series
+    finally:
+        await _stop_stack(servers, client)
+
+
 def test_breaker_window_expires_old_outcomes():
     cfg = ResilienceConfig(
         breaker_window=0.05, breaker_min_requests=3, breaker_error_rate=0.5,

@@ -1,0 +1,467 @@
+"""Soak harness tests (benchmarks/soak.py, docs/SOAK.md) — CPU-only.
+
+Covers the pure ladder/attainment math on synthetic latency streams, the
+declarative fault-schedule parser, the BENCH_soak_*.json schema gate, the
+zero-5xx assertion wiring, and a short (<60s) fake-engine soak through
+the REAL router with one mid-soak engine restart and a slow-straggler
+degrade — the chaos classes the subprocess harness injects for real.
+"""
+
+import asyncio
+import json
+import time
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from benchmarks.multi_round_qa import RequestRecord
+from benchmarks.soak import (
+    Fault,
+    SLOClass,
+    SoakViolation,
+    assert_soak_bars,
+    build_report,
+    class_summary,
+    parse_autoscaler_gauges,
+    parse_classes,
+    parse_fault_schedule,
+    parse_slo_attainment,
+    percentile,
+    recovery_time,
+    run_ladder,
+    status_5xx,
+    validate_report,
+)
+from tests.fake_engine import FakeEngine
+from tests.test_router_e2e import _start_stack, _stop_stack
+
+
+def _rec(ttft=0.2, gen=20, gen_time=1.0, status=200, retry_after=False,
+         finish=10.0, sheds=0, cls="interactive"):
+    return RequestRecord(
+        user=0, round=0, launch_time=finish - gen_time - ttft, ttft=ttft,
+        finish_time=finish, prompt_tokens=10, generation_tokens=gen,
+        status=status, retry_after=retry_after, sheds=sheds, slo_class=cls,
+    )
+
+
+SLO = SLOClass("interactive", ttft_slo_s=0.5, itl_slo_s=0.1,
+               answer_tokens=16, share=1.0)
+
+
+# --------------------------------------------------------------- pure math
+def test_percentile_nearest_rank():
+    assert percentile([5, 1, 3, 2, 4], 0.5) == 3
+    assert percentile([5, 1, 3, 2, 4], 0.99) == 5
+    assert percentile([7], 0.99) == 7
+    assert percentile([], 0.5) is None
+
+
+def test_class_summary_attainment_and_goodput():
+    records = (
+        # 6 OK within both SLOs: gen_time 0.95s over 20 tokens -> itl 0.05
+        [_rec(ttft=0.2, gen=20, gen_time=0.95) for _ in range(6)]
+        # 2 OK but TTFT-miss
+        + [_rec(ttft=0.9, gen=20, gen_time=0.95) for _ in range(2)]
+        # 1 OK but ITL-miss (gen_time 4s over 20 tokens -> itl ~0.21)
+        + [_rec(ttft=0.2, gen=20, gen_time=4.0)]
+        # 1 terminal shed (excluded from the attainment denominator)
+        + [_rec(status=503, retry_after=True, gen=0)]
+        # 1 error (counts as a miss)
+        + [_rec(status=500, gen=0)]
+    )
+    s = class_summary(records, SLO, duration_s=10.0)
+    assert s["requests"] == 11 and s["ok"] == 9 and s["met"] == 6
+    assert s["shed"] == 1 and s["errors"] == 1 and s["status_5xx"] == 1
+    assert s["attainment"] == pytest.approx(6 / 10)   # met / (ok + errors)
+    assert s["goodput_tok_s"] == pytest.approx(6 * 20 / 10.0)
+    assert s["output_tok_s"] == pytest.approx(9 * 20 / 10.0)
+    assert s["p99_ttft_s"] == pytest.approx(0.9)
+    assert s["p99_itl_s"] == pytest.approx(4.0 / 19)
+
+
+def test_shed_is_not_an_error():
+    shed = _rec(status=503, retry_after=True)
+    bare_503 = _rec(status=503, retry_after=False)
+    transport = _rec(status=599)
+    assert status_5xx([shed]) == 0
+    assert status_5xx([bare_503]) == 1
+    assert status_5xx([transport]) == 1
+    s = class_summary([shed, bare_503, transport], SLO, 1.0)
+    assert s["shed"] == 1 and s["errors"] == 2
+
+
+def test_recovery_time_windows():
+    cls = [SLO]
+    # Fault at t=100: misses until 112, healthy completions after.
+    records = (
+        [_rec(ttft=2.0, finish=100 + i, gen=20, gen_time=0.95)
+         for i in range(12)]          # TTFT-missing post-fault stragglers
+        + [_rec(ttft=0.1, finish=112 + 0.2 * i, gen=20, gen_time=0.95)
+           for i in range(20)]        # recovered
+    )
+    rec = recovery_time(records, 100.0, cls, window_s=5.0, threshold=0.9,
+                        horizon_s=60.0)
+    # Windows [100,105) and [105,110) miss; [110,115) is mixed
+    # (2 misses, 15 hits -> 0.88 < 0.9); [115,116) qualifies.
+    assert rec == pytest.approx(20.0)
+    # Nothing ever recovers -> None.
+    assert recovery_time(records[:12], 100.0, cls, window_s=5.0,
+                         threshold=0.9, horizon_s=30.0) is None
+
+
+def test_recovery_counts_sheds_and_skips_empty_windows():
+    cls = [SLO]
+    records = [
+        _rec(status=503, retry_after=True, finish=101.0),  # shed: a miss
+        _rec(ttft=0.1, finish=123.0, gen=20, gen_time=0.95),
+    ]
+    rec = recovery_time(records, 100.0, cls, window_s=5.0, threshold=0.9,
+                        horizon_s=60.0)
+    assert rec == pytest.approx(25.0)   # the [120,125) window, not [100,105)
+
+
+def test_recovery_not_fooled_by_shed_saturation():
+    """A window where nearly all traffic is shed is NOT recovered, even
+    if the few served requests all met their SLO — turning away 95% of
+    load gracefully is still an unrecovered service."""
+    cls = [SLO]
+    records = (
+        # [100,105): 2 perfect completions drowned in 40 sheds.
+        [_rec(ttft=0.1, finish=101 + 0.1 * i, gen=20, gen_time=0.95)
+         for i in range(2)]
+        + [_rec(status=503, retry_after=True, finish=101 + 0.05 * i)
+           for i in range(40)]
+        # [105,110): sheds cleared, real traffic back within SLO.
+        + [_rec(ttft=0.1, finish=106 + 0.2 * i, gen=20, gen_time=0.95)
+           for i in range(10)]
+    )
+    rec = recovery_time(records, 100.0, cls, window_s=5.0, threshold=0.9,
+                        horizon_s=60.0)
+    assert rec == pytest.approx(10.0)   # not 5.0
+
+
+# ------------------------------------------------------------ fault parsing
+def test_fault_schedule_parses_and_sorts():
+    faults = parse_fault_schedule(json.dumps([
+        {"at_s": 30, "action": "restart_kv_server"},
+        {"at_s": 10, "action": "restart_engine", "engine": 1},
+        {"at_s": 20, "action": "degrade_engine", "engine": 0,
+         "itl": 0.05, "jitter": 0.01},
+    ]))
+    assert [f.action for f in faults] == [
+        "restart_engine", "degrade_engine", "restart_kv_server",
+    ]
+    assert faults[0].engine == 1
+    assert faults[1].params == {"itl": 0.05, "jitter": 0.01}
+
+
+@pytest.mark.parametrize("bad", [
+    [{"at_s": 5, "action": "set_on_fire"}],
+    [{"action": "restart_engine"}],
+    [{"at_s": -1, "action": "restart_engine"}],
+    ["restart_engine"],
+])
+def test_fault_schedule_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        parse_fault_schedule(json.dumps(bad))
+
+
+def test_parse_classes():
+    classes = parse_classes(json.dumps([
+        {"name": "rt", "ttft_slo_s": 0.5, "itl_slo_s": 0.05,
+         "answer_tokens": 16, "share": 0.6},
+        {"name": "bulk", "ttft_slo_s": 5.0, "itl_slo_s": 0.5,
+         "answer_tokens": 128, "share": 0.4, "rounds": 1},
+    ]))
+    assert classes[0].name == "rt" and classes[1].rounds == 1
+    with pytest.raises(ValueError):
+        parse_classes("[]")
+    with pytest.raises(ValueError):
+        parse_classes('[{"name": "x"}]')
+    h = classes[0].headers()
+    assert h["x-slo-class"] == "rt" and h["x-slo-ttft"] == "0.5"
+
+
+# ------------------------------------------------------------ report schema
+def _tiny_report(**overrides):
+    s = class_summary([_rec(gen_time=0.95)], SLO, 1.0)
+    kwargs = dict(
+        model="tiny-llama", backend="cpu", num_engines=2, classes=[SLO],
+        rungs=[{"qps": 1.0, "duration_s": 1.0,
+                "users": {"interactive": 1}, "capped_classes": [],
+                "classes": {"interactive": s}}],
+        faults=[{"action": "restart_engine", "engine": 1, "at_s": 0.5,
+                 "ok": True, "recovery_s": 2.0, "recovery_ok": True}],
+        autoscaler_gauges={"router_queue_depth": True},
+    )
+    kwargs.update(overrides)
+    return build_report(**kwargs)
+
+
+def test_report_schema_roundtrips_json():
+    report = _tiny_report()
+    validate_report(json.loads(json.dumps(report)))
+    assert report["schema"] == "pstpu-soak-v1"
+    assert report["zero_5xx"] is True
+    assert report["totals"]["requests"] == 1
+
+
+def test_report_schema_rejects_missing_keys():
+    report = _tiny_report()
+    for key in ("ladder", "totals", "zero_5xx", "faults"):
+        broken = dict(report)
+        del broken[key]
+        with pytest.raises(ValueError):
+            validate_report(broken)
+    broken = json.loads(json.dumps(report))
+    del broken["ladder"][0]["classes"]["interactive"]["goodput_tok_s"]
+    with pytest.raises(ValueError):
+        validate_report(broken)
+
+
+def test_zero_5xx_bar_wiring():
+    ok_report = _tiny_report()
+    assert_soak_bars(ok_report, max_recovery_s=60.0)   # no raise
+
+    bad = class_summary([_rec(status=500, gen=0)], SLO, 1.0)
+    rep = _tiny_report(
+        rungs=[{"qps": 1.0, "duration_s": 1.0, "users": {"interactive": 1},
+                "capped_classes": [], "classes": {"interactive": bad}}],
+    )
+    assert rep["zero_5xx"] is False
+    with pytest.raises(SoakViolation):
+        assert_soak_bars(rep, max_recovery_s=60.0)
+
+    # Sheds alone never trip the bar.
+    shed_only = class_summary(
+        [_rec(gen_time=0.95, sheds=2), _rec(status=503, retry_after=True)],
+        SLO, 1.0,
+    )
+    rep = _tiny_report(
+        rungs=[{"qps": 1.0, "duration_s": 1.0, "users": {"interactive": 1},
+                "capped_classes": [], "classes": {"interactive": shed_only}}],
+    )
+    assert rep["zero_5xx"] is True
+
+    # Unrecovered fault trips the recovery bar.
+    rep = _tiny_report(
+        faults=[{"action": "restart_engine", "engine": 1, "at_s": 0.5,
+                 "ok": True, "recovery_s": None, "recovery_ok": False}],
+    )
+    with pytest.raises(SoakViolation):
+        assert_soak_bars(rep, max_recovery_s=60.0)
+
+    # A fault whose INJECTION failed must not turn the gate green by
+    # injecting no chaos at all.
+    rep = _tiny_report(
+        faults=[{"action": "restart_engine", "engine": 1, "at_s": 0.5,
+                 "ok": False, "error": "wait_health timeout",
+                 "recovery_s": None, "recovery_ok": False}],
+    )
+    with pytest.raises(SoakViolation, match="FAILED to inject"):
+        assert_soak_bars(rep, max_recovery_s=60.0)
+
+    # Scheduled-but-never-fired faults (ladder ended early) also fail.
+    rep = _tiny_report(faults_scheduled=3)
+    with pytest.raises(SoakViolation, match="scheduled faults fired"):
+        assert_soak_bars(rep, max_recovery_s=60.0)
+
+    # Skipped faults (degrade on a real engine: 404) stay non-fatal.
+    rep = _tiny_report(
+        faults=[{"action": "degrade_engine", "engine": 0, "at_s": 0.5,
+                 "ok": True, "skipped": True, "recovery_s": None,
+                 "recovery_ok": False}],
+    )
+    assert_soak_bars(rep, max_recovery_s=60.0)
+
+
+def test_metrics_text_parsers():
+    text = (
+        "# HELP router_queue_depth x\n"
+        'router_queue_depth{server="http://e1"} 3\n'
+        'router_kv_pressure{server="http://e1"} 0.25\n'
+        'router_pool_utilization{role="unified"} 1.5\n'
+        'router_slo_attainment{slo_class="interactive"} 0.97\n'
+        'router_slo_attainment{slo_class="batch"} 1.0\n'
+    )
+    gauges = parse_autoscaler_gauges(text)
+    assert all(gauges.values()), gauges
+    assert parse_slo_attainment(text) == {"interactive": 0.97, "batch": 1.0}
+    partial = parse_autoscaler_gauges("# HELP router_queue_depth x\n")
+    assert not partial["router_queue_depth"]   # HELP alone is not live
+
+
+# ------------------------------------------------- fake-engine soak (e2e)
+async def test_fake_engine_soak_with_restart_and_straggler():
+    """A short soak through the REAL router over fake engines: one
+    mid-soak 'restart' (engine refuses connections, then heals) and one
+    slow-straggler degrade injected over POST /fault — zero client 5xx,
+    measured recovery, per-class summaries, validated report schema."""
+    engines, servers, urls, client = await _start_stack(
+        n_engines=2,
+        breaker_window=1.0, breaker_min_requests=2, breaker_error_rate=0.5,
+        breaker_open_duration=0.2, breaker_half_open_dwell=0.3,
+        retry_max_attempts=4,
+    )
+    for e in engines:
+        e.speed = 400.0
+    base_url = f"http://127.0.0.1:{client.server.port}"
+    classes = (
+        SLOClass("interactive", ttft_slo_s=2.0, itl_slo_s=0.5,
+                 answer_tokens=8, share=0.7, rounds=2),
+        SLOClass("batch", ttft_slo_s=5.0, itl_slo_s=1.0,
+                 answer_tokens=16, share=0.3, rounds=2),
+    )
+    faults = parse_fault_schedule(json.dumps([
+        {"at_s": 1.0, "action": "restart_engine", "engine": 1},
+        {"at_s": 3.0, "action": "degrade_engine", "engine": 0,
+         "itl": 0.02, "jitter": 0.01},
+        {"at_s": 4.5, "action": "heal_engine", "engine": 0},
+    ]))
+
+    async def executor(fault: Fault):
+        eng = engines[fault.engine]
+        if fault.action == "restart_engine":
+            # Dead-pod window, then healed — the subprocess harness does
+            # this with SIGTERM + relaunch (stack.restart_engine).
+            eng.refuse_connections = True
+            await asyncio.sleep(0.8)
+            eng.heal()
+            return {"downtime_s": 0.8}
+        # Degrade/heal ride the same POST /fault surface the subprocess
+        # executor uses (fake engines serve it; TestServer has real ports).
+        url = urls[fault.engine]
+        payload = ({"action": "straggler", **fault.params}
+                   if fault.action == "degrade_engine"
+                   else {"action": "heal"})
+        from benchmarks.soak import _post_fault
+
+        return await asyncio.to_thread(_post_fault, url, payload)
+
+    t0 = time.monotonic()
+    rungs, fault_log, records = await run_ladder(
+        base_url, "m1", classes, ladder=[3.0, 5.0], rung_duration_s=3.0,
+        faults=faults, fault_executor=executor,
+        recovery_window_s=1.0, recovery_threshold=0.8, max_recovery_s=20.0,
+        max_users_per_class=8,
+    )
+    elapsed = time.monotonic() - t0
+    assert elapsed < 60.0, elapsed
+
+    report = build_report(
+        model="m1", backend="fake", num_engines=2, classes=classes,
+        rungs=rungs, faults=fault_log,
+        autoscaler_gauges=parse_autoscaler_gauges(
+            await (await client.get("/metrics")).text()
+        ),
+    )
+    await _stop_stack(servers, client)
+
+    # Chaos gate: zero 5xx through the restart + straggler, bounded
+    # recovery for every injected fault.
+    assert report["totals"]["requests"] > 10
+    assert report["totals"]["status_5xx"] == 0, report["totals"]
+    assert report["totals"]["errors"] == 0, report["totals"]
+    assert report["zero_5xx"] is True
+    assert len(fault_log) == 3
+    assert all(f["ok"] for f in fault_log), fault_log
+    restart = next(f for f in fault_log if f["action"] == "restart_engine")
+    assert restart["recovery_ok"], fault_log
+    assert not engines[0].straggler_itl          # heal applied over /fault
+    # Both classes summarized on both rungs, with the schema's key set.
+    for rung in report["ladder"]:
+        assert set(rung["classes"]) == {"interactive", "batch"}
+        for cls in rung["classes"].values():
+            assert cls["p99_ttft_s"] is not None
+    # The autoscaler gauges were live on the router during the soak.
+    assert report["autoscaler_gauges"]["router_queue_depth"]
+    assert report["autoscaler_gauges"]["router_slo_attainment"]
+    assert_soak_bars(report, max_recovery_s=20.0)
+
+
+async def test_bench_client_honors_retry_after():
+    """A backend shedding 503+Retry-After is retried after the advertised
+    backoff, recorded as sheds (not errors), and the round ultimately
+    succeeds — the soak accounting satellite."""
+    from benchmarks.multi_round_qa import WorkloadConfig, run_workload
+
+    engines, servers, urls, client = await _start_stack(
+        n_engines=1, breaker_min_requests=100, retry_max_attempts=1,
+    )
+    try:
+        base_url = f"http://127.0.0.1:{client.server.port}"
+        engines[0].fail_for(1.2)        # shed window shorter than retries
+        cfg = WorkloadConfig(
+            base_url=base_url, model="m1", num_users=1, num_rounds=1,
+            answer_tokens=4, honor_retry_after=True, raise_on_error=False,
+            slo_class="interactive",
+        )
+        records = await run_workload(cfg)
+        assert len(records) == 1
+        r = records[0]
+        assert r.ok and r.sheds >= 1, (r.status, r.sheds)
+        assert r.slo_class == "interactive"
+    finally:
+        await _stop_stack(servers, client)
+
+
+async def test_truncated_stream_counts_as_error():
+    """A backend dying mid-SSE (no data:[DONE]) is truncation-only on the
+    wire — but the client received a broken answer, so the record must be
+    an error (599), never a 200: otherwise the zero-5xx chaos gate would
+    be blind to hard mid-stream kills."""
+    from benchmarks.multi_round_qa import (
+        WorkloadConfig,
+        run_workload,
+        summarize,
+    )
+
+    eng = FakeEngine(model="m1", speed=500.0)
+    eng.die_after_chunks = 2
+    server = TestServer(eng.build_app())
+    client = TestClient(server)
+    await client.start_server()
+    try:
+        cfg = WorkloadConfig(
+            base_url=f"http://127.0.0.1:{server.port}", model="m1",
+            num_users=1, num_rounds=1, answer_tokens=8,
+            raise_on_error=False, slo_class="interactive",
+        )
+        records = await run_workload(cfg)
+    finally:
+        await client.close()
+    assert len(records) == 1
+    assert records[0].status == 599 and not records[0].ok
+    s = summarize(records)
+    assert s["errors_total"] == 1 and s["finished_requests"] == 0
+    assert status_5xx(records) == 1     # fails the chaos gate, as it must
+
+
+async def test_fake_engine_straggler_mode():
+    """set_straggler slows the stream (per-chunk latency) without killing
+    it — the degraded-but-alive fault class."""
+    eng = FakeEngine(model="m1", speed=10000.0)
+    server = TestServer(eng.build_app())
+    client = TestClient(server)
+    await client.start_server()
+    try:
+        async def one():
+            t0 = time.monotonic()
+            resp = await client.post("/v1/completions", json={
+                "model": "m1", "prompt": "x", "max_tokens": 5,
+                "stream": True,
+            })
+            assert resp.status == 200
+            raw = (await resp.content.read()).decode()
+            assert raw.count("data:") == 6   # 5 chunks + [DONE]
+            return time.monotonic() - t0
+
+        fast = await one()
+        eng.set_straggler(0.05, 0.0)
+        slow = await one()
+        assert slow > fast + 0.15, (fast, slow)
+        eng.heal()
+        assert eng.straggler_itl == 0.0
+    finally:
+        await client.close()

@@ -30,6 +30,7 @@ TABLES = {
     "dispatch": "docs/PERF.md",
     "disagg": "docs/DISAGG.md",
     "resilience": "docs/RESILIENCE.md",
+    "autoscaling": "docs/SOAK.md",
 }
 
 FLAG_TABLES = {

@@ -92,6 +92,10 @@ REGISTRY: Tuple[Series, ...] = (
     Series("pstpu:kv_offload_blocks", "gauge", ("model_name",),
            _BOTH_ENGINE, ("catalogue",),
            "KV blocks resident in the host offload pool"),
+    Series("pstpu:queue_depth", "gauge", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "autoscaling"),
+           "Engine backlog (running + waiting requests) — the per-pod "
+           "HPA metric"),
     Series("pstpu:decode_dispatches_total", "counter", ("model_name",),
            _BOTH_ENGINE, ("catalogue", "dispatch"),
            "Fused decode dispatches issued"),
@@ -195,6 +199,25 @@ REGISTRY: Tuple[Series, ...] = (
            ("catalogue", "resilience"),
            "Deadline aborts (kind: ttft or total)",
            router_labels=("server", "kind")),
+    # ------------------------------------------------ router: autoscaling
+    Series("router_queue_depth", "gauge", (), (ROUTER,),
+           ("catalogue", "autoscaling"),
+           "Engine-reported running+waiting requests per backend "
+           "(queue-depth scale-up signal)",
+           router_labels=("server",)),
+    Series("router_kv_pressure", "gauge", (), (ROUTER,),
+           ("catalogue", "autoscaling"),
+           "KV-pool usage fraction per backend (HBM pressure)",
+           router_labels=("server",)),
+    Series("router_pool_utilization", "gauge", (), (ROUTER,),
+           ("catalogue", "autoscaling"),
+           "Mean in-flight depth per engine in each disagg role pool",
+           router_labels=("role",)),
+    Series("router_slo_attainment", "gauge", (), (ROUTER,),
+           ("catalogue", "autoscaling"),
+           "Rolling-window fraction of x-slo-class requests meeting their "
+           "soft TTFT target",
+           router_labels=("slo_class",)),
     Series("router_disagg_handoffs_total", "counter", (), (ROUTER,),
            ("catalogue", "disagg"),
            "Prefill->decode handoffs completed through the two-hop flow",
