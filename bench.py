@@ -141,8 +141,26 @@ def bench_stack(args) -> dict:
         run_workload,
         summarize,
     )
-    from benchmarks.stack import launch_stack
+    from benchmarks.stack import launch_kv_server, launch_stack
 
+    # Prefix-aware routing (docs/KV_ECONOMY.md) needs the full KV economy:
+    # a shared cache server every engine spills to (so the router's
+    # shared-tier restorability rung is live) and the router hashing
+    # prompts with the engines' exact tokenizer.
+    kv_proc = kv_log_f = None
+    router_args = ["--session-key", "x-user-id"]
+    engine_env = None
+    if args.routing_logic == "prefix-aware":
+        kv_proc, kv_url, _kv_log, kv_log_f = launch_kv_server()
+        engine_env = {"LMCACHE_REMOTE_URL": kv_url}
+        router_args += [
+            "--prefix-tokenizer", args.model,
+            "--kv-offload-url", kv_url,
+            # Residency moves fast under a bench workload; scrape the
+            # digests faster than the default 10s or the index trails the
+            # rounds it should be routing.
+            "--engine-stats-interval", "2",
+        ]
     stack = launch_stack(
         args.model,
         engine_args=[
@@ -155,8 +173,9 @@ def bench_stack(args) -> dict:
             *(["--no-overlap-dispatch"] if args.no_overlap else []),
         ],
         routing_logic=args.routing_logic,
-        router_args=["--session-key", "x-user-id"],
+        router_args=router_args,
         num_engines=args.num_engines,
+        engine_env=engine_env,
     )
     try:
         cfg = WorkloadConfig(
@@ -185,6 +204,14 @@ def bench_stack(args) -> dict:
         h1, q1 = _scrape_prefix_counters(stack.engine_urls)
     finally:
         stack.terminate()
+        if kv_proc is not None and kv_proc.poll() is None:
+            kv_proc.terminate()
+            try:
+                kv_proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001 — last resort
+                kv_proc.kill()
+        if kv_log_f is not None:
+            kv_log_f.close()
     summary = summarize(records)
     if not summary.get("finished_requests"):
         raise RuntimeError(
@@ -469,9 +496,12 @@ def main():
                          "(clamped to fit --max-model-len; 0 disables)")
     ap.add_argument("--routing-logic", default="session",
                     choices=["roundrobin", "session",
-                             "cache_aware_load_balancing"],
+                             "cache_aware_load_balancing", "prefix-aware"],
                     help="router routing logic for the stack run (sweep "
-                         "A/B: session vs cache-aware)")
+                         "A/B: session vs cache-aware vs prefix-aware; "
+                         "prefix-aware also launches a shared cache "
+                         "server and wires --prefix-tokenizer/"
+                         "--kv-offload-url, docs/KV_ECONOMY.md)")
     ap.add_argument("--num-engines", type=int, default=1,
                     help="engine subprocesses behind the router; 2-process "
                          "smoke: --model facebook/opt-125m --num-engines 2 "

@@ -124,6 +124,24 @@ class EngineConfig:
     kv_remote_serde: str = field(
         default_factory=lambda: os.environ.get("LMCACHE_REMOTE_SERDE", "naive")
     )
+    # Restore-over-recompute admission (docs/KV_ECONOMY.md): on prefill the
+    # offload manager restores the longest tier-resident prefix instead of
+    # recomputing it when est. transfer time (bytes / link bandwidth) beats
+    # est. prefill time (tokens / prefill throughput). Both estimates are
+    # deliberately coarse knobs, not measurements: the decision only has to
+    # be right in the regimes that matter (a 1000-token shared system
+    # prompt is ~always worth restoring; a single cold block behind a slow
+    # link is not).
+    kv_restore_link_gbps: float = field(
+        default_factory=lambda: float(
+            os.environ.get("PSTPU_KV_RESTORE_LINK_GBPS", "2.0")
+        )
+    )
+    kv_restore_prefill_tok_s: float = field(
+        default_factory=lambda: float(
+            os.environ.get("PSTPU_KV_RESTORE_PREFILL_TOK_S", "4000")
+        )
+    )
     # --- LoRA (vLLM --lora-modules convention: name -> PEFT checkpoint dir)
     lora_modules: Dict[str, str] = field(default_factory=dict)
     # --- weights ---

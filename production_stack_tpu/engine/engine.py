@@ -115,6 +115,12 @@ class ServingEngine:
                 ),
                 remote_url=config.kv_remote_url,
                 serde=config.kv_remote_serde,
+                # Restore-over-recompute cost model (docs/KV_ECONOMY.md).
+                bytes_per_token=config.kv_cache_bytes_per_token(
+                    self.model_config
+                ),
+                link_gbps=config.kv_restore_link_gbps,
+                prefill_tok_s=config.kv_restore_prefill_tok_s,
             )
         # Prefill/decode disaggregation (docs/DISAGG.md): non-unified roles
         # get a coordinator for the KV handoff plane (its own store
@@ -848,6 +854,9 @@ class ServingEngine:
         ))
 
     # ------------------------------------------------------------------ stats
+    def _offload_stat(self, attr: str) -> int:
+        return getattr(self.offload, attr, 0) if self.offload else 0
+
     def stats(self) -> Dict:
         disagg = self.disagg.stats() if self.disagg is not None else {
             "kv_handoffs_total": 0,
@@ -879,6 +888,21 @@ class ServingEngine:
             "kv_cache_usage": self.block_manager.usage(),
             "prefix_cache_hits": self.block_manager.prefix_hits_total,
             "prefix_cache_queries": self.block_manager.prefix_queries_total,
+            # KV economy (docs/KV_ECONOMY.md): device prefix-index size +
+            # shared-tier restore/eviction telemetry.
+            "prefix_index_size": self.block_manager.prefix_index_size,
+            "kv_restore_saved_tokens_total": self._offload_stat(
+                "restore_saved_tokens_total"
+            ),
+            "kv_shared_tier_hits_total": self._offload_stat(
+                "shared_tier_hits_total"
+            ),
+            "kv_shared_tier_misses_total": self._offload_stat(
+                "shared_tier_misses_total"
+            ),
+            "kv_chain_evictions_total": self._offload_stat(
+                "chain_evictions_total"
+            ),
             "num_preemptions": self.scheduler.num_preemptions_total,
             "prompt_tokens_total": self.prompt_tokens_total,
             "generation_tokens_total": self.generation_tokens_total,

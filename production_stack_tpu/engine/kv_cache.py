@@ -48,6 +48,11 @@ class BlockPoolManager:
         # content hash -> block id (full blocks only)
         self._hash_to_block: Dict[bytes, int] = {}
         self._block_to_hash: Dict[int, bytes] = {}
+        # content hash -> parent hash in its chain (the prev_hash it was
+        # registered under; the hash seed for chain roots). The offload
+        # spiller reads it to carry chain links into the shared tier, and
+        # prefix_digest() walks it to publish chain structure.
+        self._hash_parent: Dict[bytes, bytes] = {}
         # evictable: blocks with ref 0 still holding cached content (LRU order)
         self._evictable: "OrderedDict[int, None]" = OrderedDict()
         # blocks queued for offload spill: excluded from eviction until the
@@ -90,6 +95,7 @@ class BlockPoolManager:
             h = self._block_to_hash.pop(blk, None)
             if h is not None:
                 self._hash_to_block.pop(h, None)
+                self._hash_parent.pop(h, None)
             return blk
         return None
 
@@ -102,6 +108,33 @@ class BlockPoolManager:
 
     def hash_of_block(self, blk: int) -> Optional[bytes]:
         return self._block_to_hash.get(blk)
+
+    def parent_hash(self, h: bytes) -> Optional[bytes]:
+        """Parent hash in ``h``'s chain (the seed for chain roots); None if
+        ``h`` is no longer registered."""
+        return self._hash_parent.get(h)
+
+    # ----------------------------------------------------------- prefix index
+    @property
+    def prefix_index_size(self) -> int:
+        """Content-addressed blocks currently resident (device prefix
+        cache) — the pstpu:prefix_index_size gauge."""
+        return len(self._hash_to_block)
+
+    def prefix_digest(self, max_entries: int = 8192) -> Tuple[List[str], bool]:
+        """Compact digest of the device-resident prefix index: truncated
+        hex (16 chars = 8 bytes) of every content-addressed block hash,
+        newest chains implicitly protected by the cap being far above real
+        residency. Returns (entries, truncated). The router's cross-engine
+        prefix index (docs/KV_ECONOMY.md) is built from these digests; the
+        router hashes an incoming prompt with the engine's exact chain
+        scheme and takes the longest contiguous run present here."""
+        entries = []
+        for h in self._hash_to_block:
+            entries.append(h.hex()[:16])
+            if len(entries) >= max_entries:
+                return entries, True
+        return entries, False
 
     def can_allocate(self, n: int) -> bool:
         return self.num_free_blocks >= n
@@ -202,6 +235,7 @@ class BlockPoolManager:
             return h
         self._hash_to_block[h] = blk
         self._block_to_hash[blk] = h
+        self._hash_parent[h] = prev_hash
         return h
 
     # ----------------------------------------------------------------- free
@@ -224,4 +258,5 @@ class BlockPoolManager:
             h = self._block_to_hash.pop(blk, None)
             if h is not None:
                 self._hash_to_block.pop(h, None)
+                self._hash_parent.pop(h, None)
         self._evictable.clear()

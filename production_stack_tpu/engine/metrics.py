@@ -68,6 +68,29 @@ class EngineMetricsCollector(Collector):
         yield gauge("pstpu:kv_offload_blocks",
                     "KV blocks resident in the host offload pool",
                     eng.offload_blocks_resident)
+        # KV economy (docs/KV_ECONOMY.md): device prefix-index size (the
+        # quantity the /prefix_index digest publishes) plus shared-tier
+        # restore/eviction telemetry from the offload manager.
+        yield gauge("pstpu:prefix_index_size",
+                    "Content-addressed blocks resident in the device "
+                    "prefix cache (the /prefix_index digest size)",
+                    bm.prefix_index_size)
+        yield counter("pstpu:kv_restore_saved_tokens_total",
+                      "Prompt tokens restored from the shared KV tier "
+                      "instead of recomputed (cost-model admitted)",
+                      eng._offload_stat("restore_saved_tokens_total"))
+        yield counter("pstpu:kv_shared_tier_hits_total",
+                      "KV blocks served by the shared host/remote tiers "
+                      "during prefill restores",
+                      eng._offload_stat("shared_tier_hits_total"))
+        yield counter("pstpu:kv_shared_tier_misses_total",
+                      "Restore-candidate KV blocks the shared tiers did "
+                      "not hold",
+                      eng._offload_stat("shared_tier_misses_total"))
+        yield counter("pstpu:kv_chain_evictions_total",
+                      "Leaf-first chain evictions in the local host KV "
+                      "tier (a child evicted while its parent stayed)",
+                      eng._offload_stat("chain_evictions_total"))
         # Dispatch-pipeline overlap telemetry (two-slot prefill/decode
         # overlap, engine.py:_run_loop): the overlap win is observable.
         yield counter("pstpu:decode_dispatches_total",

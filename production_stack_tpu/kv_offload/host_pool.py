@@ -1,14 +1,20 @@
-"""Host-RAM KV tier: block-hash -> packed KV bytes with LRU eviction.
+"""Host-RAM KV tier: block-hash -> packed KV bytes, chain-aware LRU.
 
 The reference's LMCACHE_LOCAL_CPU / LMCACHE_MAX_LOCAL_CPU_SIZE tier
 (reference helm/templates/deployment-vllm-multi.yaml:198-205). Thread-safe:
 the engine's spiller thread writes while the scheduler path reads.
+
+Eviction is prefix-chain-aware (kv_offload/chain_lru.py): entries carry
+their chain-parent's key, eviction is leaf-first LRU over chains (a parent
+always outlives its descendants, so every resident block stays restorable
+from its chain root), and a leaf hit refreshes its whole chain — shared
+long prefixes stay warm while cold per-session tails age out first
+(docs/KV_ECONOMY.md).
 """
 
-import threading
-from collections import OrderedDict
 from typing import Optional
 
+from production_stack_tpu.kv_offload.chain_lru import ChainStore
 from production_stack_tpu.utils import init_logger
 
 logger = init_logger(__name__)
@@ -16,50 +22,21 @@ logger = init_logger(__name__)
 
 class HostKVPool:
     def __init__(self, max_bytes: int):
-        self.max_bytes = max_bytes
-        self._data: "OrderedDict[bytes, bytes]" = OrderedDict()
-        self._bytes = 0
-        self._lock = threading.Lock()
-        self.hits = 0
-        self.misses = 0
-        self.stores = 0
-        self.evictions = 0
+        self._store = ChainStore(max_bytes)
 
-    def put(self, key: bytes, blob: bytes) -> None:
-        with self._lock:
-            old = self._data.pop(key, None)
-            if old is not None:
-                self._bytes -= len(old)
-            self._data[key] = blob
-            self._bytes += len(blob)
-            self.stores += 1
-            while self._bytes > self.max_bytes and self._data:
-                _, evicted = self._data.popitem(last=False)
-                self._bytes -= len(evicted)
-                self.evictions += 1
+    def put(self, key: bytes, blob: bytes,
+            parent: Optional[bytes] = None) -> None:
+        self._store.put(key, blob, parent=parent)
 
     def get(self, key: bytes) -> Optional[bytes]:
-        with self._lock:
-            blob = self._data.get(key)
-            if blob is None:
-                self.misses += 1
-                return None
-            self._data.move_to_end(key)
-            self.hits += 1
-            return blob
+        return self._store.get(key)
 
     def contains(self, key: bytes) -> bool:
-        with self._lock:
-            return key in self._data
+        return self._store.contains(key)
+
+    @property
+    def chain_evictions(self) -> int:
+        return self._store.chain_evictions
 
     def stats(self) -> dict:
-        with self._lock:
-            return {
-                "entries": len(self._data),
-                "bytes": self._bytes,
-                "max_bytes": self.max_bytes,
-                "hits": self.hits,
-                "misses": self.misses,
-                "stores": self.stores,
-                "evictions": self.evictions,
-            }
+        return self._store.stats()

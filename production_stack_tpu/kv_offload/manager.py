@@ -27,10 +27,44 @@ import numpy as np
 
 from production_stack_tpu.engine.kv_cache import BlockPoolManager, _block_hash
 from production_stack_tpu.kv_offload.host_pool import HostKVPool
-from production_stack_tpu.kv_offload.serde import get_serde
+from production_stack_tpu.kv_offload.serde import (
+    get_serde,
+    pack_chain,
+    unpack_chain,
+)
 from production_stack_tpu.utils import init_logger
 
 logger = init_logger(__name__)
+
+
+def restore_beats_recompute(
+    num_tokens: int,
+    bytes_per_token: int,
+    link_gbps: float,
+    prefill_tok_s: float,
+    transfer_tokens: Optional[int] = None,
+) -> bool:
+    """Restore-over-recompute admission (docs/KV_ECONOMY.md): restore a
+    tier-resident prefix iff its estimated byte-transfer time beats the
+    estimated prefill time. ``transfer_tokens`` is the subset that must
+    actually cross the network link (remote-resident blocks); host-pool
+    blocks are in-process RAM copies and cost ~nothing, so a fully local
+    run always restores. Coarse by design — the decision only has to be
+    right in the regimes that matter (a 1000-token shared system prompt is
+    ~always worth restoring; recompute wins only when the link is slow
+    relative to prefill throughput times per-token KV bytes). Non-positive
+    knobs disable the model (always restore), preserving the pre-model
+    behavior."""
+    if num_tokens <= 0:
+        return False
+    t = num_tokens if transfer_tokens is None else transfer_tokens
+    if t <= 0:
+        return True
+    if link_gbps <= 0 or prefill_tok_s <= 0 or bytes_per_token <= 0:
+        return True
+    transfer_s = t * bytes_per_token / (link_gbps * 1e9)
+    recompute_s = num_tokens / prefill_tok_s
+    return transfer_s < recompute_s
 
 
 class KVOffloadManager:
@@ -43,6 +77,9 @@ class KVOffloadManager:
         serde: str = "naive",
         flush_interval: float = 0.1,
         spill_batch: int = 8,
+        bytes_per_token: int = 0,
+        link_gbps: float = 2.0,
+        prefill_tok_s: float = 4000.0,
     ):
         self.runner = runner
         self.block_manager = block_manager
@@ -63,6 +100,10 @@ class KVOffloadManager:
         self._key_prefix = b"q8|" if self._kv_quantized else b""
         self.flush_interval = flush_interval
         self.spill_batch = spill_batch
+        # Restore-over-recompute cost model inputs (EngineConfig knobs).
+        self.bytes_per_token = bytes_per_token
+        self.link_gbps = link_gbps
+        self.prefill_tok_s = prefill_tok_s
 
         self._queue: List[Tuple[bytes, int]] = []
         self._queued_hashes = set()
@@ -75,6 +116,13 @@ class KVOffloadManager:
         # telemetry
         self.restored_tokens_total = 0
         self.spilled_blocks_total = 0
+        # KV-economy counters (docs/KV_ECONOMY.md): blocks served from /
+        # missing in the shared tiers during restores, tokens restored
+        # under cost-model admission, and tokens the model declined.
+        self.shared_tier_hits_total = 0
+        self.shared_tier_misses_total = 0
+        self.restore_saved_tokens_total = 0
+        self.restore_declined_tokens_total = 0
 
     @property
     def enabled(self) -> bool:
@@ -135,14 +183,25 @@ class KVOffloadManager:
         for i, (h, blk) in enumerate(live):
             if self.block_manager.hash_of_block(blk) != h:
                 continue  # recycled during the read; data is unreliable
-            blob = self.pack(
+            # Chain link (docs/KV_ECONOMY.md): the stored blob carries its
+            # parent block's STORE KEY so the shared tier evicts leaf-first
+            # over chains. Chain roots (parent = the hash seed, not a
+            # registered block hash) carry an empty parent.
+            parent = self.block_manager.parent_hash(h)
+            # Real parent hashes are exactly the blake2b digest size; hash
+            # seeds (chain roots, LoRA namespaces) are anything else.
+            parent_key = (
+                self._store_key(parent)
+                if parent is not None and len(parent) == 16 else b""
+            )
+            blob = pack_chain(parent_key, self.pack(
                 k_np[i], v_np[i],
                 None if ks_np is None else ks_np[i],
                 None if vs_np is None else vs_np[i],
-            )
+            ))
             key = self._store_key(h)
             if self.host_pool is not None:
-                self.host_pool.put(key, blob)
+                self.host_pool.put(key, blob, parent=parent_key or None)
             if self.remote is not None:
                 try:
                     self.remote.put(key, blob)
@@ -151,23 +210,6 @@ class KVOffloadManager:
             self.spilled_blocks_total += 1
 
     # --------------------------------------------------------------- read path
-    def _fetch(self, h: bytes) -> Optional[bytes]:
-        key = self._store_key(h)
-        if self.host_pool is not None:
-            blob = self.host_pool.get(key)
-            if blob is not None:
-                return blob
-        if self.remote is not None:
-            try:
-                blob = self.remote.get(key)
-            except ConnectionError as e:
-                logger.warning("Remote KV get failed: %s", e)
-                return None
-            if blob is not None and self.host_pool is not None:
-                self.host_pool.put(key, blob)  # promote to the local tier
-            return blob
-        return None
-
     def try_restore(
         self,
         token_ids: Sequence[int],
@@ -183,6 +225,13 @@ class KVOffloadManager:
         chain exactly like the device prefix cache (Sequence.hash_seed): KV
         computed under different LoRA adapters must never be spliced across
         adapters from the host/remote tiers either.
+
+        Pipelined (docs/KV_ECONOMY.md): all candidate hashes are computed
+        up front, remote residency is resolved with ONE 'I' index query,
+        the restore-over-recompute cost model admits (or declines) the
+        contiguous resident run, and the remote blocks arrive in ONE 'M'
+        multi-get — at most 2 remote round trips per restore instead of
+        one per block.
         """
         if not self.enabled:
             return 0
@@ -198,20 +247,77 @@ class KVOffloadManager:
         # At least one token must remain for prefill to compute logits from.
         max_full = (len(token_ids) - 1) // bs
         start_blk = num_computed_tokens // bs
-        hits: List[Tuple[int, tuple]] = []
+        if start_blk >= max_full:
+            return 0
+        hashes: List[bytes] = []
         for i in range(start_blk, max_full):
-            h = _block_hash(prev, token_ids[i * bs:(i + 1) * bs])
-            blob = self._fetch(h)
+            prev = _block_hash(prev, token_ids[i * bs:(i + 1) * bs])
+            hashes.append(prev)
+        keys = [self._store_key(h) for h in hashes]
+        # Residency: the local tier answers in-process; the remote tier in
+        # one index-query round trip (covering only what the host missed).
+        host_res = [
+            self.host_pool is not None and self.host_pool.contains(k)
+            for k in keys
+        ]
+        remote_res = [False] * len(keys)
+        if self.remote is not None and not all(host_res):
+            try:
+                remote_res = self.remote.index_query(keys)
+            except ConnectionError as e:
+                logger.warning("Remote KV index query failed: %s", e)
+        run = 0
+        while run < len(keys) and (host_res[run] or remote_res[run]):
+            run += 1
+        self.shared_tier_misses_total += len(keys) - run
+        if run == 0:
+            return 0
+        # Restore-over-recompute admission: only the remote blocks cross
+        # the link; host-pool blocks are free RAM copies.
+        remote_blocks = sum(1 for i in range(run) if not host_res[i])
+        if not restore_beats_recompute(
+            run * bs, self.bytes_per_token,
+            self.link_gbps, self.prefill_tok_s,
+            transfer_tokens=remote_blocks * bs,
+        ):
+            self.restore_declined_tokens_total += run * bs
+            return 0
+        # Fetch: local hits from the host pool, everything else in ONE
+        # pipelined multi-get.
+        blobs: List[Optional[bytes]] = [None] * run
+        for i in range(run):
+            if host_res[i]:
+                blobs[i] = self.host_pool.get(keys[i])
+        remote_idx = [i for i in range(run) if blobs[i] is None]
+        if remote_idx and self.remote is not None:
+            try:
+                fetched = self.remote.multi_get(
+                    [keys[i] for i in remote_idx]
+                )
+            except ConnectionError as e:
+                logger.warning("Remote KV multi-get failed: %s", e)
+                fetched = [None] * len(remote_idx)
+            for i, blob in zip(remote_idx, fetched):
+                blobs[i] = blob
+        hits: List[Tuple[int, tuple]] = []
+        for i in range(run):
+            blob = blobs[i]
             if blob is None:
-                break
-            k, v, ks, vs = self.unpack(blob)
+                break  # residency raced an eviction; keep the prefix we got
+            parent_key, inner = unpack_chain(blob)
+            k, v, ks, vs = self.unpack(inner)
             if (ks is not None) != self._kv_quantized:
                 # Wire/pool dtype mismatch (store written under another
                 # kv_cache_dtype, possible despite key namespacing via a
                 # hand-migrated store): treat as a miss, never splice.
                 break
-            hits.append((block_ids[i], (k, v, ks, vs)))
-            prev = h
+            hits.append((block_ids[start_blk + i], (k, v, ks, vs)))
+            if self.host_pool is not None and not host_res[i]:
+                # Promote remote blocks to the local tier, chain intact.
+                self.host_pool.put(
+                    keys[i], blob,
+                    parent=parent_key or (keys[i - 1] if i > 0 else None),
+                )
         if not hits:
             return 0
         blks = [b for b, _ in hits]
@@ -227,16 +333,30 @@ class KVOffloadManager:
             self.runner.write_blocks(blks, k_np, v_np)
         restored = len(hits) * bs
         self.restored_tokens_total += restored
+        self.restore_saved_tokens_total += restored
+        self.shared_tier_hits_total += len(hits)
         # Offload hits count toward the prefix-cache telemetry the router's
         # cache-aware logic consumes (LMCache hits do the same upstream).
         self.block_manager.prefix_hits_total += restored
         logger.debug("Restored %d tokens from KV offload", restored)
         return restored
 
+    @property
+    def chain_evictions_total(self) -> int:
+        """Leaf-first chain evictions in the local host tier (the
+        pstpu:kv_chain_evictions_total counter)."""
+        return self.host_pool.chain_evictions if self.host_pool else 0
+
     def stats(self) -> dict:
         out = {
             "restored_tokens_total": self.restored_tokens_total,
             "spilled_blocks_total": self.spilled_blocks_total,
+            "shared_tier_hits_total": self.shared_tier_hits_total,
+            "shared_tier_misses_total": self.shared_tier_misses_total,
+            "restore_saved_tokens_total": self.restore_saved_tokens_total,
+            "restore_declined_tokens_total":
+                self.restore_declined_tokens_total,
+            "chain_evictions_total": self.chain_evictions_total,
         }
         if self.host_pool is not None:
             out["host_pool"] = self.host_pool.stats()

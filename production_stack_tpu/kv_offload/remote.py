@@ -7,18 +7,22 @@ with native/kv_server.cpp and the Python fallback server):
   request:  op(1) | key_len(u32 LE) | key | val_len(u64 LE) | val
   response: status(1: 0=ok, 1=missing, 2=error) | val_len(u64 LE) | val
 
-ops: 'P' put, 'G' get, 'E' exists, 'D' delete, 'T' stats(JSON). One request
-in flight per connection; the client serializes with a lock (callers run on
-the engine's spiller thread or the disagg handoff executor, never the event
-loop). The native C++ server predates 'D' and answers it with STATUS_ERROR;
-delete() treats that as "not deleted" rather than raising.
+ops: 'P' put, 'G' get, 'E' exists, 'D' delete, 'T' stats(JSON), plus the
+batched pair (docs/KV_ECONOMY.md): 'M' pipelined multi-get (val = packed
+key list, response = per-key status|len|blob) and 'I' index-query (val =
+packed key list, response = residency bitmap, one byte per key). One
+request in flight per connection; the client serializes with a lock
+(callers run on the engine's spiller thread or the disagg handoff
+executor, never the event loop). The native C++ server predates 'D'/'M'/
+'I' and answers them with STATUS_ERROR; delete() treats that as "not
+deleted" and the batched ops degrade to per-key loops.
 """
 
 import json
 import socket
 import struct
 import threading
-from typing import Optional
+from typing import List, Optional, Sequence
 from urllib.parse import urlparse
 
 from production_stack_tpu.utils import init_logger
@@ -59,6 +63,14 @@ class RemoteKVClient:
         self.io_timeout = io_timeout
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
+        # Wire round trips issued (one per _request attempt that reached
+        # the send). The restore path's efficiency bar — N blocks in <= 2
+        # round trips via 'I' + 'M' instead of N gets — is asserted
+        # against this counter (tests/test_kv_economy.py).
+        self.round_trips = 0
+        # The native C++ server predates the batched ops and answers them
+        # STATUS_ERROR; remember that and degrade to per-key ops.
+        self._batched_ops_ok = True
 
     def _ensure_sock(self) -> socket.socket:
         if self._sock is None:
@@ -81,6 +93,7 @@ class RemoteKVClient:
             for attempt in (0, 1):
                 try:
                     sock = self._ensure_sock()
+                    self.round_trips += 1
                     sock.sendall(
                         op + struct.pack("<I", len(key)) + key
                         + struct.pack("<Q", len(val)) + val
@@ -121,6 +134,55 @@ class RemoteKVClient:
         key existed and was deleted."""
         status, _ = self._request(b"D", key)
         return status == STATUS_OK
+
+    def multi_get(self, keys: Sequence[bytes]) -> List[Optional[bytes]]:
+        """Pipelined batch get ('M'): ONE round trip for the whole restore
+        run instead of one per block. Falls back to sequential get() against
+        servers that predate the op (native C++ server answers
+        STATUS_ERROR)."""
+        if not keys:
+            return []
+        if self._batched_ops_ok:
+            from production_stack_tpu.kv_offload.server import pack_key_list
+
+            status, payload = self._request(b"M", b"", pack_key_list(keys))
+            if status == STATUS_OK:
+                out: List[Optional[bytes]] = []
+                off = 0
+                try:
+                    for _ in keys:
+                        st = payload[off]
+                        (vlen,) = struct.unpack_from("<Q", payload, off + 1)
+                        off += 9
+                        out.append(
+                            payload[off:off + vlen] if st == STATUS_OK
+                            else None
+                        )
+                        off += vlen
+                    return out
+                except (IndexError, struct.error) as e:
+                    raise ConnectionError(
+                        f"malformed multi-get response: {e}"
+                    ) from e
+            self._batched_ops_ok = False
+        return [self.get(k) for k in keys]
+
+    def index_query(self, keys: Sequence[bytes]) -> List[bool]:
+        """Residency bitmap ('I'): which of ``keys`` the tier currently
+        holds, in one round trip and without refreshing their recency.
+        Falls back to per-key exists() on pre-batched-protocol servers."""
+        if not keys:
+            return []
+        if self._batched_ops_ok:
+            from production_stack_tpu.kv_offload.server import pack_key_list
+
+            status, payload = self._request(b"I", b"", pack_key_list(keys))
+            if status == STATUS_OK and len(payload) == len(keys):
+                return [b == 1 for b in payload]
+            if status == STATUS_OK:
+                raise ConnectionError("malformed index-query response")
+            self._batched_ops_ok = False
+        return [self.exists(k) for k in keys]
 
     def stats(self) -> dict:
         status, payload = self._request(b"T", b"")

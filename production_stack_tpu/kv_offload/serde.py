@@ -24,6 +24,15 @@ import numpy as np
 
 _MAGIC = b"PKV1"
 _MAGIC_Q = b"PKV2"
+# Chain-link envelope (docs/KV_ECONOMY.md): wraps a PKV1/PKV2 payload with
+# the STORE KEY of the chain-parent block, so the shared tier can rebuild
+# the prefix-chain structure (leaf-first eviction, chain-touch refresh)
+# from the blobs alone. Chain roots carry an empty parent. Servers that
+# predate the envelope (native C++ kv_server) treat it as an opaque blob;
+# unpack_chain passes bare PKV1/PKV2 blobs through, so pre-chain stores
+# keep decoding.
+_MAGIC_CHAIN = b"PKC1"
+_HDR_CHAIN = "<4sH"
 _DTYPES = {0: "bfloat16", 1: "float32", 2: "float16", 3: "int8"}
 _DTYPE_IDS = {v: k for k, v in _DTYPES.items()}
 _HDR = "<4sB4I"
@@ -99,6 +108,26 @@ def unpack_block(
         blob, sdtype, count=ns, offset=soff + ns * sdtype.itemsize
     ).reshape(nl, hkv, bs)
     return k, v, k_scale, v_scale
+
+
+def pack_chain(parent_key: bytes, inner: bytes) -> bytes:
+    """Wrap a packed KV blob with its chain-parent's store key (empty for
+    chain roots)."""
+    return (
+        struct.pack(_HDR_CHAIN, _MAGIC_CHAIN, len(parent_key))
+        + parent_key + inner
+    )
+
+
+def unpack_chain(blob: bytes) -> Tuple[bytes, bytes]:
+    """-> (parent_key, inner). Bare PKV1/PKV2 blobs (pre-chain stores, or
+    blobs round-tripped through a chain-unaware server) pass through with
+    an empty parent."""
+    if blob[:4] != _MAGIC_CHAIN:
+        return b"", blob
+    _, plen = struct.unpack_from(_HDR_CHAIN, blob)
+    off = struct.calcsize(_HDR_CHAIN)
+    return blob[off:off + plen], blob[off + plen:]
 
 
 def get_serde(name: str):

@@ -15,13 +15,41 @@ import shutil
 import struct
 import subprocess
 import sys
-from collections import OrderedDict
 
+from production_stack_tpu.kv_offload.chain_lru import ChainStore
+from production_stack_tpu.kv_offload.serde import unpack_chain
 from production_stack_tpu.utils import init_logger
 
 logger = init_logger(__name__)
 
 STATUS_OK, STATUS_MISSING, STATUS_ERROR = 0, 1, 2
+
+
+def unpack_key_list(val: bytes):
+    """Parse the 'M'/'I' request payload: u32 count | (u32 klen | key)*.
+    Raises ValueError on a malformed payload."""
+    if len(val) < 4:
+        raise ValueError("key-list payload too short")
+    (count,) = struct.unpack_from("<I", val, 0)
+    off = 4
+    keys = []
+    for _ in range(count):
+        if off + 4 > len(val):
+            raise ValueError("truncated key-list payload")
+        (klen,) = struct.unpack_from("<I", val, off)
+        off += 4
+        if off + klen > len(val):
+            raise ValueError("truncated key in key-list payload")
+        keys.append(val[off:off + klen])
+        off += klen
+    return keys
+
+
+def pack_key_list(keys) -> bytes:
+    out = [struct.pack("<I", len(keys))]
+    for k in keys:
+        out.append(struct.pack("<I", len(k)) + k)
+    return b"".join(out)
 
 
 def find_native_binary() -> str:
@@ -38,14 +66,22 @@ def find_native_binary() -> str:
 
 
 class PyKVServer:
-    """Pure-Python fallback implementing the same protocol + LRU bound."""
+    """Pure-Python fallback implementing the same protocol.
+
+    Eviction is prefix-chain-aware (kv_offload/chain_lru.py): 'P' payloads
+    wrapped in the PKC1 chain envelope (kv_offload/serde.py) declare their
+    parent block's store key, eviction is leaf-first LRU over chains (a
+    parent is never evicted before its descendants), and a leaf hit
+    refreshes its whole chain. Two batched ops extend the flat protocol:
+    'M' pipelined multi-get (one round trip for a whole restore run) and
+    'I' index-query (prefix store keys -> residency bitmap, the router's
+    shared-tier restorability probe). The native C++ server predates both
+    and answers them with STATUS_ERROR; RemoteKVClient degrades to per-key
+    ops there.
+    """
 
     def __init__(self, max_bytes: int):
-        self.max_bytes = max_bytes
-        self._data: "OrderedDict[bytes, bytes]" = OrderedDict()
-        self._bytes = 0
-        self.hits = self.misses = self.stores = self.evictions = 0
-        self.deletes = 0
+        self.store = ChainStore(max_bytes)
 
     async def handle(self, reader: asyncio.StreamReader,
                      writer: asyncio.StreamWriter) -> None:
@@ -68,44 +104,58 @@ class PyKVServer:
 
     def _dispatch(self, op: bytes, key: bytes, val: bytes):
         if op == b"P":
-            old = self._data.pop(key, None)
-            if old is not None:
-                self._bytes -= len(old)
-            self._data[key] = val
-            self._bytes += len(val)
-            self.stores += 1
-            while self._bytes > self.max_bytes and self._data:
-                _, ev = self._data.popitem(last=False)
-                self._bytes -= len(ev)
-                self.evictions += 1
+            # A PKC1 chain envelope declares the parent block's store key;
+            # the blob is stored AS RECEIVED (clients unwrap on read), so
+            # chain-unaware peers round-trip it untouched.
+            parent, _ = unpack_chain(val)
+            self.store.put(key, val, parent=parent or None)
             return STATUS_OK, b""
         if op == b"G":
-            blob = self._data.get(key)
+            blob = self.store.get(key)
             if blob is None:
-                self.misses += 1
                 return STATUS_MISSING, b""
-            self._data.move_to_end(key)
-            self.hits += 1
             return STATUS_OK, blob
+        if op == b"M":
+            # Pipelined multi-get: one round trip for a whole restore run.
+            # Response: per key, u8 status | u64 len | blob.
+            try:
+                keys = unpack_key_list(val)
+            except ValueError:
+                return STATUS_ERROR, b""
+            parts = []
+            for blob in self.store.multi_get(keys):
+                if blob is None:
+                    parts.append(bytes([STATUS_MISSING])
+                                 + struct.pack("<Q", 0))
+                else:
+                    parts.append(bytes([STATUS_OK])
+                                 + struct.pack("<Q", len(blob)) + blob)
+            return STATUS_OK, b"".join(parts)
+        if op == b"I":
+            # Index query: prefix store keys -> residency bitmap (one byte
+            # per key). Read-only — does NOT refresh recency, so router
+            # probes can't keep cold chains artificially warm.
+            try:
+                keys = unpack_key_list(val)
+            except ValueError:
+                return STATUS_ERROR, b""
+            return STATUS_OK, bytes(
+                1 if r else 0 for r in self.store.residency(keys)
+            )
         if op == b"E":
-            return (STATUS_OK if key in self._data else STATUS_MISSING), b""
+            return (
+                STATUS_OK if self.store.contains(key) else STATUS_MISSING
+            ), b""
         if op == b"D":
             # Delete-after-consume lease for disagg transfer bundles: the
             # decode engine frees the blob once rehydrated so consumed
             # transfers don't sit in host memory until LRU pressure.
-            old = self._data.pop(key, None)
-            if old is None:
+            if not self.store.delete(key):
                 return STATUS_MISSING, b""
-            self._bytes -= len(old)
-            self.deletes += 1
             return STATUS_OK, b""
         if op == b"T":
             return STATUS_OK, json.dumps({
-                "entries": len(self._data), "bytes": self._bytes,
-                "max_bytes": self.max_bytes, "hits": self.hits,
-                "misses": self.misses, "stores": self.stores,
-                "evictions": self.evictions, "deletes": self.deletes,
-                "impl": "python",
+                **self.store.stats(), "impl": "python",
             }).encode()
         return STATUS_ERROR, b""
 

@@ -180,6 +180,7 @@ async def handle_metrics(request: web.Request) -> web.Response:
     # role pool so prefill and decode pools can be sized independently.
     pool_depth: dict = {}
     pool_size: dict = {}
+    prefix_index = get_engine_stats_scraper().get_prefix_index()
     for ep in endpoints:
         es = engine_stats.get(ep.url)
         rs = request_stats.get(ep.url)
@@ -194,6 +195,17 @@ async def handle_metrics(request: web.Request) -> web.Response:
         metrics.router_kv_pressure.labels(server=ep.url).set(
             es.gpu_cache_usage_perc if es is not None else 0.0
         )
+        # KV economy (docs/KV_ECONOMY.md): the scraped per-backend
+        # prefix-cache hit rate as a first-class router series, and the
+        # backend's prefix-digest size (0 unless prefix-aware routing has
+        # the /prefix_index poll on).
+        metrics.router_backend_kv_hit_rate.labels(server=ep.url).set(
+            es.gpu_prefix_cache_hit_rate if es is not None else 0.0
+        )
+        snap = prefix_index.get(ep.url)
+        metrics.router_prefix_index_entries.labels(server=ep.url).set(
+            len(snap.entries) if snap is not None else 0
+        )
         role = (getattr(ep, "role", "") or
                 (es.role if es is not None else "") or "unified")
         pool_depth[role] = pool_depth.get(role, 0) + depth
@@ -207,7 +219,9 @@ async def handle_metrics(request: web.Request) -> web.Response:
     # so a dead pod's stale depth would inflate the scale signal forever.
     live_servers = {ep.url for ep in endpoints}
     for gone in _autoscale_published["server"] - live_servers:
-        for gauge in (metrics.router_queue_depth, metrics.router_kv_pressure):
+        for gauge in (metrics.router_queue_depth, metrics.router_kv_pressure,
+                      metrics.router_backend_kv_hit_rate,
+                      metrics.router_prefix_index_entries):
             try:
                 gauge.remove(gone)
             except KeyError:
@@ -335,11 +349,27 @@ def initialize_all(app: web.Application, args) -> None:
             "k8s", namespace=args.k8s_namespace, port=args.k8s_port,
             label_selector=args.k8s_label_selector,
         )
-    initialize_engine_stats_scraper(args.engine_stats_interval)
+    initialize_engine_stats_scraper(
+        args.engine_stats_interval,
+        # The per-backend /prefix_index poll only pays for itself when the
+        # prefix-aware logic consumes it (docs/KV_ECONOMY.md).
+        scrape_prefix_index=(args.routing_logic == "prefix-aware"),
+    )
     initialize_request_stats_monitor(args.request_stats_window)
+    routing_kwargs = {}
+    if args.routing_logic == "prefix-aware":
+        # Scoped to prefix-aware: load_weight would otherwise override the
+        # cache-aware router's own tuned default.
+        routing_kwargs = dict(
+            kv_offload_url=getattr(args, "kv_offload_url", None),
+            prefix_tokenizer=getattr(args, "prefix_tokenizer", None),
+            prefix_weight=getattr(args, "prefix_weight", 1.0),
+            load_weight=getattr(args, "prefix_load_weight", 0.5),
+        )
     initialize_routing_logic(
         args.routing_logic, session_key=args.session_key,
         block_reuse_timeout=args.block_reuse_timeout,
+        **routing_kwargs,
     )
     # getattr defaults keep pre-resilience arg namespaces (operator-rendered
     # configs, test fixtures) working.

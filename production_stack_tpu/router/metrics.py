@@ -119,6 +119,21 @@ router_slo_attainment = Gauge(
     "Rolling-window fraction of x-slo-class requests meeting their soft "
     "TTFT target (sheds and failures count as misses)", ["slo_class"],
 )
+# KV economy (docs/KV_ECONOMY.md): the scraped per-backend prefix-cache
+# hit rate made a first-class router series (the fork's engine_stats
+# scraper already computes it per interval — this exports it), and the
+# size of each backend's scraped prefix digest (how much of the fleet's
+# device residency the prefix-aware router can actually see).
+router_backend_kv_hit_rate = Gauge(
+    "router_backend_kv_hit_rate",
+    "Per-interval prefix-cache hit rate per backend, from the engine "
+    "/metrics scrape plane", ["server"],
+)
+router_prefix_index_entries = Gauge(
+    "router_prefix_index_entries",
+    "Entries in the backend's last scraped /prefix_index digest "
+    "(prefix-aware routing's view of device residency)", ["server"],
+)
 # Prefill/decode disaggregation (docs/DISAGG.md): two-hop flow outcomes.
 router_disagg_handoffs_total = Counter(
     "router_disagg_handoffs",

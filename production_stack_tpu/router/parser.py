@@ -32,9 +32,12 @@ def parse_args(argv=None) -> argparse.Namespace:
 
     p.add_argument("--routing-logic", default="roundrobin",
                    choices=["roundrobin", "session",
-                            "cache_aware_load_balancing", "disagg"],
+                            "cache_aware_load_balancing", "disagg",
+                            "prefix-aware"],
                    help="backend selection policy (disagg enables the "
-                        "two-hop prefill/decode flow, docs/DISAGG.md)")
+                        "two-hop prefill/decode flow, docs/DISAGG.md; "
+                        "prefix-aware routes on measured global prefix "
+                        "residency, docs/KV_ECONOMY.md)")
     p.add_argument("--session-key", default=None,
                    help="request header whose value pins a session to a "
                         "backend (session/cache-aware routing)")
@@ -48,7 +51,20 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--kv-offload-url", default=None,
                    help="shared KV offload store URL (kv://host:port) the "
                         "disagg prefill->decode handoff rides; required and "
-                        "probed for reachability with --routing-logic disagg")
+                        "probed for reachability with --routing-logic "
+                        "disagg, optional (shared-tier restorability "
+                        "fallback) with prefix-aware")
+    p.add_argument("--prefix-tokenizer", default=None,
+                   help="model name/path whose tokenizer the prefix-aware "
+                        "router hashes prompts with (must match the "
+                        "engines' tokenizer; without it only token-id "
+                        "prompts are prefix-hashed, docs/KV_ECONOMY.md)")
+    p.add_argument("--prefix-weight", type=float, default=1.0,
+                   help="prefix-aware routing: weight of the matched "
+                        "global-index prefix fraction in the backend score")
+    p.add_argument("--prefix-load-weight", type=float, default=0.5,
+                   help="prefix-aware routing: weight of the backend load "
+                        "score subtracted from the prefix match")
 
     p.add_argument("--engine-stats-interval", type=float, default=10.0,
                    help="seconds between engine /metrics scrape passes")
@@ -155,6 +171,13 @@ def validate_args(args: argparse.Namespace) -> None:
                 "--kv-offload-url required with --routing-logic disagg "
                 "(the prefill->decode KV handoff rides the offload store)"
             )
+        _probe_kv_offload_url(args.kv_offload_url)
+    if args.routing_logic == "prefix-aware" and \
+            getattr(args, "kv_offload_url", None):
+        # Optional for prefix-aware (the index + affinity rungs work
+        # without a shared tier), but if configured it must be reachable —
+        # a typo'd URL silently disabling the restorability rung is the
+        # failure mode this probe exists for.
         _probe_kv_offload_url(args.kv_offload_url)
     if getattr(args, "static_backend_roles", None):
         roles = [r.strip() for r in args.static_backend_roles.split(",")]

@@ -34,6 +34,7 @@ class RoutingLogic:
     SESSION = "session"
     CACHE_AWARE_LB = "cache_aware_load_balancing"
     DISAGG = "disagg"
+    PREFIX_AWARE = "prefix-aware"
 
 
 class RoutingInterface(metaclass=SingletonABCMeta):
@@ -227,6 +228,361 @@ class CacheAwareLoadBalancingRouter(RoutingInterface):
         return best_url
 
 
+class PrefixAwareRouter(RoutingInterface):
+    """Route on MEASURED global prefix residency, not affinity guesses
+    (docs/KV_ECONOMY.md; the RadixAttention / prefix-cache-aware-routing
+    shape).
+
+    The router block-hashes the incoming prompt with the engine's exact
+    chain scheme (engine/kv_cache.py:_block_hash, seed b"") and scores each
+    backend against the cross-engine prefix index the stats scraper builds
+    from the engines' /prefix_index digests:
+
+        score = prefix_weight * matched_prefix_fraction - load_weight * load
+
+    where matched_prefix_fraction is the longest contiguous run of the
+    prompt's block hashes present in that backend's digest, over the
+    prompt's full blocks. Fallback ladder when no backend holds the prefix:
+
+      1. shared-tier restorability — if the offload store holds the chain
+         head (one 'I' index query, both dtype namespaces), ANY engine can
+         restore it, so pick the least-loaded backend;
+      2. session affinity (the cache-aware router's map) — fresh affinity
+         wins, else least-loaded.
+
+    Degrades gracefully: a stale/absent index contributes score 0, a down
+    kv server trips a cooldown (no per-request reconnect storms), and a
+    missing tokenizer limits hashing to token-id prompts — every failure
+    lands in the fallback ladder, never an exception on the data plane.
+    """
+
+    def __init__(
+        self,
+        session_key: Optional[str] = None,
+        block_reuse_timeout: float = 300.0,
+        prefix_weight: float = 1.0,
+        load_weight: float = 0.5,
+        kv_offload_url: Optional[str] = None,
+        prefix_tokenizer=None,
+        index_provider=None,
+        kv_client=None,
+        max_prefix_blocks: int = 512,
+        index_ttl: float = 60.0,
+        kv_down_cooldown: float = 30.0,
+        **_,
+    ):
+        if hasattr(self, "_initialized"):
+            return
+        self._initialized = True
+        self.session_key = session_key
+        self.block_reuse_timeout = block_reuse_timeout
+        self.prefix_weight = prefix_weight
+        self.load_weight = load_weight
+        self.max_prefix_blocks = max_prefix_blocks
+        self.index_ttl = index_ttl
+        self.kv_down_cooldown = kv_down_cooldown
+        self._index_provider = index_provider
+        self._tokenizer = prefix_tokenizer   # object with .encode, or a
+        self._tokenizer_spec = (             # model name/path to lazy-load
+            prefix_tokenizer if isinstance(prefix_tokenizer, str) else None
+        )
+        self._tokenizer_failed = False
+        self._kv_client = kv_client
+        self._kv_url = kv_offload_url
+        self._kv_down_until = 0.0
+        # session -> (engine_url, last_seen_ts) — the final fallback rung.
+        self._affinity = LRUCache(capacity=8192)
+        self._rr = 0
+        # decision telemetry (surfaced through /health-style debugging and
+        # unit tests; Prometheus export stays on the scrape plane)
+        self.routed_by_index = 0
+        self.routed_by_tier = 0
+        self.routed_by_fallback = 0
+        # Load the tokenizer EAGERLY: the HF path can cost seconds of
+        # import + disk I/O, which belongs in router startup, never in the
+        # first data-plane route_request.
+        if self._tokenizer_spec is not None:
+            self._get_tokenizer()
+
+    # ------------------------------------------------------------- tokenizer
+    def _get_tokenizer(self):
+        if self._tokenizer is not None and \
+                not isinstance(self._tokenizer, str):
+            return self._tokenizer
+        if self._tokenizer_spec is None or self._tokenizer_failed:
+            return None
+        try:
+            from production_stack_tpu.engine.tokenizer import get_tokenizer
+            from production_stack_tpu.models.config import (
+                resolve_model_config,
+            )
+
+            self._tokenizer = get_tokenizer(
+                self._tokenizer_spec,
+                resolve_model_config(self._tokenizer_spec),
+            )
+            return self._tokenizer
+        except Exception:  # noqa: BLE001 — degrade to token-id-only hashing
+            logger.exception(
+                "prefix-aware router could not load tokenizer %r; only "
+                "token-id prompts will be prefix-hashed",
+                self._tokenizer_spec,
+            )
+            self._tokenizer_failed = True
+            return None
+
+    def _prompt_token_ids(self, request) -> Optional[List[int]]:
+        body = getattr(request, "json_body", None)
+        if not isinstance(body, dict):
+            return None
+        prompt = body.get("prompt")
+        if isinstance(prompt, list) and prompt and \
+                all(type(t) is int for t in prompt):
+            return prompt
+        tok = self._get_tokenizer()
+        if isinstance(prompt, list) and prompt and \
+                all(isinstance(p, str) for p in prompt):
+            prompt = prompt[0]   # multi-prompt: route on the first
+        if isinstance(prompt, str) and tok is not None:
+            return tok.encode(prompt)
+        messages = body.get("messages")
+        if messages and tok is not None:
+            try:
+                # The engine's exact prompt construction
+                # (api_server.chat_completions) — template divergence would
+                # silently zero every match.
+                text = tok.apply_chat_template(
+                    messages, add_generation_prompt=True
+                )
+                return tok.encode(text)
+            except Exception:  # noqa: BLE001 — malformed messages
+                logger.warning(
+                    "prefix-aware router failed to render chat template; "
+                    "falling back past the index", exc_info=True,
+                )
+        return None
+
+    # ----------------------------------------------------------------- hashes
+    def _prefix_hashes(self, token_ids, block_size: int) -> List[bytes]:
+        """Chain hashes of the prompt's full blocks (seed b"", the
+        non-LoRA namespace the engines publish), capped at
+        max_prefix_blocks."""
+        from production_stack_tpu.engine.kv_cache import _block_hash
+
+        if block_size <= 0:
+            return []
+        max_full = min(
+            (len(token_ids) - 1) // block_size, self.max_prefix_blocks
+        )
+        hashes = []
+        prev = b""
+        for i in range(max_full):
+            prev = _block_hash(
+                prev, token_ids[i * block_size:(i + 1) * block_size]
+            )
+            hashes.append(prev)
+        return hashes
+
+    def _index(self) -> dict:
+        if self._index_provider is not None:
+            try:
+                return self._index_provider() or {}
+            except Exception:  # noqa: BLE001 — index is advisory
+                logger.warning("prefix index provider failed", exc_info=True)
+                return {}
+        try:
+            from production_stack_tpu.router.stats.engine_stats import (
+                get_engine_stats_scraper,
+            )
+
+            return get_engine_stats_scraper().get_prefix_index()
+        except Exception:  # noqa: BLE001 — scraper not initialized (tests)
+            logger.warning("prefix index unavailable", exc_info=True)
+            return {}
+
+    def matched_prefix_blocks(self, token_ids, snapshot,
+                              _hash_cache: Optional[dict] = None) -> int:
+        """Longest contiguous run of the prompt's block hashes present in
+        one backend's digest (truncated-hex comparison). ``_hash_cache``
+        (block_size -> hashes) amortizes the chain hashing across the
+        backends of one routing decision."""
+        if snapshot is None or not snapshot.entries:
+            return 0
+        if self.index_ttl > 0 and snapshot.scraped_at and \
+                time.time() - snapshot.scraped_at > self.index_ttl:
+            return 0   # stale digest: treat as no residency
+        if _hash_cache is not None and snapshot.block_size in _hash_cache:
+            hashes = _hash_cache[snapshot.block_size]
+        else:
+            hashes = self._prefix_hashes(token_ids, snapshot.block_size)
+            if _hash_cache is not None:
+                _hash_cache[snapshot.block_size] = hashes
+        run = 0
+        for h in hashes:
+            if h.hex()[:16] not in snapshot.entries:
+                break
+            run += 1
+        return run
+
+    # ------------------------------------------------------------ shared tier
+    def _tier_client(self):
+        if self._kv_client is not None:
+            return self._kv_client
+        if not self._kv_url:
+            return None
+        from production_stack_tpu.kv_offload.remote import RemoteKVClient
+
+        # Short timeouts: this client runs on the serving path; a slow
+        # store must cost milliseconds, not the io default.
+        self._kv_client = RemoteKVClient(
+            self._kv_url, connect_timeout=0.5, io_timeout=0.5
+        )
+        return self._kv_client
+
+    def tier_restorable_blocks(self, hashes: List[bytes]) -> int:
+        """Leading blocks of the prompt chain the shared offload tier
+        holds, probing both dtype namespaces (bf16 bare keys and int8
+        ``q8|`` keys) in ONE index-query round trip. 0 on any store
+        error, with a cooldown so a down store is not re-dialed per
+        request (the PR-1 degrade-don't-fail posture)."""
+        if not hashes or time.time() < self._kv_down_until:
+            return 0
+        client = self._tier_client()
+        if client is None:
+            return 0
+        if not getattr(client, "_batched_ops_ok", True):
+            # Pre-batched-protocol store (native C++ server): the per-key
+            # exists() fallback would cost up to 32 sequential round trips
+            # on the event loop per routing decision — not worth the rung.
+            return 0
+        probe = hashes[:16]
+        keys = [h for h in probe] + [b"q8|" + h for h in probe]
+        t0 = time.monotonic()
+        try:
+            bits = client.index_query(keys)
+        except (ConnectionError, OSError) as e:
+            logger.warning(
+                "shared KV tier unreachable (%s); prefix-aware routing "
+                "degrades to session affinity for %.0fs",
+                e, self.kv_down_cooldown,
+            )
+            self._kv_down_until = time.time() + self.kv_down_cooldown
+            return 0
+        if time.monotonic() - t0 > 0.25:
+            # Alive but slow: a per-request stall on the router's event
+            # loop serializes ALL traffic. Back off the same way a hard
+            # failure does.
+            logger.warning(
+                "shared KV tier index query took %.2fs; cooling the "
+                "restorability rung for %.0fs",
+                time.monotonic() - t0, self.kv_down_cooldown,
+            )
+            self._kv_down_until = time.time() + self.kv_down_cooldown
+        n = len(probe)
+        run = 0
+        for i in range(n):
+            if bits[i] or bits[n + i]:
+                run += 1
+            else:
+                break
+        return run
+
+    # --------------------------------------------------------------- routing
+    def route_request(self, endpoints, engine_stats, request_stats,
+                      request) -> str:
+        if not endpoints:
+            raise ValueError("No available endpoints for routing")
+        session_id = None
+        headers = getattr(request, "headers", None)
+        if headers is not None and self.session_key:
+            session_id = headers.get(self.session_key)
+
+        token_ids = self._prompt_token_ids(request)
+        index = self._index() if token_ids else {}
+        hash_cache: dict = {}
+        best_url, best_score, best_match = None, float("-inf"), 0
+        for ep in sorted(endpoints, key=lambda e: e.url):
+            snap = index.get(ep.url)
+            matched = (
+                self.matched_prefix_blocks(token_ids, snap, hash_cache)
+                if token_ids else 0
+            )
+            if token_ids and snap is not None and snap.block_size > 0:
+                total = max(
+                    1, min((len(token_ids) - 1) // snap.block_size,
+                           self.max_prefix_blocks)
+                )
+            else:
+                total = 1
+            load = CacheAwareLoadBalancingRouter._engine_load_score(
+                ep.url, engine_stats, request_stats
+            )
+            score = (self.prefix_weight * (matched / total)
+                     - self.load_weight * load)
+            if score > best_score:
+                best_url, best_score, best_match = ep.url, score, matched
+
+        if best_match > 0:
+            self.routed_by_index += 1
+            if session_id is not None:
+                self._affinity.put(session_id, (best_url, time.time()))
+            return best_url
+
+        # Nothing device-resident anywhere: if the shared tier can restore
+        # the prefix, every engine is equally warm — take the least-loaded.
+        if token_ids:
+            # Probe at the most common block size among live digests (the
+            # fleet normally agrees); default to the engine default.
+            sizes = [s.block_size for s in index.values() if s.block_size]
+            if sizes:
+                bs = max(set(sizes), key=sizes.count)
+            else:
+                # No live digests to learn the fleet's block size from:
+                # fall back to the engine default rather than a literal
+                # (a block_size-32 fleet would otherwise hash to keys the
+                # store never holds and silently lose this rung).
+                from production_stack_tpu.engine.config import EngineConfig
+
+                bs = EngineConfig.block_size
+            hashes = hash_cache.get(bs) or self._prefix_hashes(token_ids, bs)
+            if self.tier_restorable_blocks(hashes) > 0:
+                self.routed_by_tier += 1
+                url = self._least_loaded(
+                    endpoints, engine_stats, request_stats
+                )
+                if session_id is not None:
+                    self._affinity.put(session_id, (url, time.time()))
+                return url
+
+        # Final rung: the existing session-affinity logic.
+        self.routed_by_fallback += 1
+        if session_id is not None:
+            entry = self._affinity.get(session_id)
+            if entry is not None and \
+                    time.time() - entry[1] < self.block_reuse_timeout:
+                for ep in endpoints:
+                    if ep.url == entry[0]:
+                        self._affinity.put(session_id, (ep.url, time.time()))
+                        return ep.url
+        url = self._least_loaded(endpoints, engine_stats, request_stats)
+        if session_id is not None:
+            self._affinity.put(session_id, (url, time.time()))
+        return url
+
+    def _least_loaded(self, endpoints, engine_stats, request_stats) -> str:
+        best_url, best = None, float("inf")
+        for ep in sorted(endpoints, key=lambda e: e.url):
+            load = CacheAwareLoadBalancingRouter._engine_load_score(
+                ep.url, engine_stats, request_stats
+            )
+            if load < best:
+                best_url, best = ep.url, load
+        if best_url is None:  # defensive; endpoints is never empty here
+            best_url = endpoints[self._rr % len(endpoints)].url
+            self._rr += 1
+        return best_url
+
+
 class DisaggRouter(RoutingInterface):
     """Two-hop prefill/decode disaggregation routing (docs/DISAGG.md;
     DistServe OSDI'24 / Splitwise ISCA'24 shape).
@@ -335,6 +691,7 @@ _ROUTERS = {
     RoutingLogic.SESSION: SessionRouter,
     RoutingLogic.CACHE_AWARE_LB: CacheAwareLoadBalancingRouter,
     RoutingLogic.DISAGG: DisaggRouter,
+    RoutingLogic.PREFIX_AWARE: PrefixAwareRouter,
 }
 
 

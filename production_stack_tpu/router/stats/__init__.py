@@ -1,6 +1,7 @@
 from production_stack_tpu.router.stats.engine_stats import (  # noqa: F401
     EngineStats,
     EngineStatsScraper,
+    PrefixIndexSnapshot,
     get_engine_stats_scraper,
     initialize_engine_stats_scraper,
 )

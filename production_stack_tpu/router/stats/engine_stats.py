@@ -15,8 +15,8 @@ reference src/vllm_router/stats/engine_stats.py:
 
 import threading
 import time
-from dataclasses import dataclass
-from typing import Dict, Optional, Tuple
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Optional, Tuple
 
 from production_stack_tpu.router.service_discovery import get_service_discovery
 from production_stack_tpu.utils import SingletonMeta, init_logger
@@ -78,13 +78,32 @@ class EngineStats:
         return stats, (hits, queries)
 
 
+@dataclass(frozen=True)
+class PrefixIndexSnapshot:
+    """One backend's device-resident prefix digest (docs/KV_ECONOMY.md):
+    the truncated block hashes its /prefix_index reported, the block size
+    they were chained at, and when the scrape landed (staleness gate)."""
+
+    block_size: int = 0
+    entries: FrozenSet[str] = field(default_factory=frozenset)
+    truncated: bool = False
+    scraped_at: float = 0.0
+
+
 class EngineStatsScraper(metaclass=SingletonMeta):
-    def __init__(self, scrape_interval: float = 10.0):
+    def __init__(self, scrape_interval: float = 10.0,
+                 scrape_prefix_index: bool = False):
         if hasattr(self, "_initialized"):
             return
         self._initialized = True
         self.scrape_interval = scrape_interval
+        # Cross-engine prefix index (docs/KV_ECONOMY.md): polled from each
+        # backend's /prefix_index on the same cadence as /metrics, only
+        # when the prefix-aware routing logic is active (the extra
+        # request per backend per pass is pointless otherwise).
+        self.scrape_prefix_index = scrape_prefix_index
         self.engine_stats: Dict[str, EngineStats] = {}
+        self.prefix_index: Dict[str, PrefixIndexSnapshot] = {}
         self._prev_counters: Dict[str, Tuple[float, float]] = {}
         self._lock = threading.Lock()
         self._last_scrape = time.time()  # construction counts as a pass
@@ -113,12 +132,20 @@ class EngineStatsScraper(metaclass=SingletonMeta):
         except AssertionError:
             return
         fresh: Dict[str, EngineStats] = {}
+        fresh_index: Dict[str, PrefixIndexSnapshot] = {}
         for ep in endpoints:
             stats = self._scrape_one_endpoint(requests, ep.url)
             if stats is not None:
                 fresh[ep.url] = stats
+            if self.scrape_prefix_index:
+                snap = self._scrape_prefix_index(requests, ep.url)
+                if snap is not None:
+                    fresh_index[ep.url] = snap
         with self._lock:
             self.engine_stats = fresh
+            # Departed/unscrapable backends drop out of the index entirely
+            # (stale residency must not attract traffic).
+            self.prefix_index = fresh_index
 
     def _scrape_one_endpoint(self, requests_mod, url: str) -> Optional[EngineStats]:
         try:
@@ -133,10 +160,33 @@ class EngineStatsScraper(metaclass=SingletonMeta):
         self._prev_counters[url] = counters
         return stats
 
+    def _scrape_prefix_index(
+        self, requests_mod, url: str
+    ) -> Optional[PrefixIndexSnapshot]:
+        try:
+            resp = requests_mod.get(f"{url}/prefix_index", timeout=5)
+            resp.raise_for_status()
+            payload = resp.json()
+            return PrefixIndexSnapshot(
+                block_size=int(payload.get("block_size", 0)),
+                entries=frozenset(payload.get("entries", ())),
+                truncated=bool(payload.get("truncated", False)),
+                scraped_at=time.time(),
+            )
+        except Exception as e:  # noqa: BLE001 — engine may be down/old
+            logger.warning("Failed to scrape %s/prefix_index: %s", url, e)
+            return None
+
     # -------------------------------------------------------------- interface
     def get_engine_stats(self) -> Dict[str, EngineStats]:
         with self._lock:
             return dict(self.engine_stats)
+
+    def get_prefix_index(self) -> Dict[str, PrefixIndexSnapshot]:
+        """Per-backend prefix digests from the last scrape pass (empty
+        unless constructed with scrape_prefix_index=True)."""
+        with self._lock:
+            return dict(self.prefix_index)
 
     def get_health(self) -> bool:
         return (
@@ -148,8 +198,11 @@ class EngineStatsScraper(metaclass=SingletonMeta):
         self._running = False
 
 
-def initialize_engine_stats_scraper(scrape_interval: float = 10.0) -> EngineStatsScraper:
-    return EngineStatsScraper(scrape_interval)
+def initialize_engine_stats_scraper(
+    scrape_interval: float = 10.0,
+    scrape_prefix_index: bool = False,
+) -> EngineStatsScraper:
+    return EngineStatsScraper(scrape_interval, scrape_prefix_index)
 
 
 def get_engine_stats_scraper() -> EngineStatsScraper:

@@ -236,6 +236,7 @@ class APIServer:
         app.router.add_get("/v1/models", self.models)
         app.router.add_get("/health", self.health)
         app.router.add_get("/metrics", self.metrics)
+        app.router.add_get("/prefix_index", self.prefix_index)
         app.router.add_get("/version", self.version)
         return app
 
@@ -337,6 +338,29 @@ class APIServer:
             text=render_engine_metrics(self.engine, self.model_name),
             content_type="text/plain",
         )
+
+    async def prefix_index(self, request: web.Request) -> web.Response:
+        """Compact digest of the device-resident prefix index
+        (docs/KV_ECONOMY.md): truncated hex of every content-addressed
+        block hash plus the block size the hashes were chained at. The
+        router's EngineStatsScraper polls this on its scrape cadence to
+        build the cross-engine prefix index the prefix-aware routing
+        logic scores against."""
+        try:
+            max_entries = min(
+                int(request.query.get("max_entries", 8192)), 65536
+            )
+        except ValueError:
+            return _error(400, "max_entries must be an integer")
+        entries, truncated = self.engine.block_manager.prefix_digest(
+            max_entries
+        )
+        return web.json_response({
+            "block_size": self.engine.config.block_size,
+            "model": self.model_name,
+            "entries": entries,
+            "truncated": truncated,
+        })
 
     async def version(self, request: web.Request) -> web.Response:
         return web.json_response({"version": VERSION})

@@ -23,7 +23,10 @@ def render_engine_metrics(engine: "ServingEngine", model_name: str) -> str:
                 "kv_handoffs_total", "kv_handoff_bytes_total",
                 "kv_handoff_seconds_total", "kv_handoff_failures_total",
                 "engine_uptime_seconds", "kv_offload_blocks",
-                "kv_quant_bytes_saved_total", "queue_depth"):
+                "kv_quant_bytes_saved_total", "queue_depth",
+                "prefix_index_size", "kv_restore_saved_tokens_total",
+                "kv_shared_tier_hits_total", "kv_shared_tier_misses_total",
+                "kv_chain_evictions_total"):
         s.setdefault(key, 0)
     s.setdefault("disagg_role", "unified")
     s.setdefault("kv_cache_dtype", "bfloat16")
@@ -70,6 +73,34 @@ def render_engine_metrics(engine: "ServingEngine", model_name: str) -> str:
         "offload pool",
         "# TYPE pstpu:kv_offload_blocks gauge",
         f"pstpu:kv_offload_blocks{label} {s['kv_offload_blocks']}",
+        # KV economy (docs/KV_ECONOMY.md): device prefix-index size (the
+        # /prefix_index digest quantity) + shared-tier restore/eviction
+        # telemetry (the collector renders the same five series).
+        "# HELP pstpu:prefix_index_size Content-addressed blocks resident "
+        "in the device prefix cache (the /prefix_index digest size)",
+        "# TYPE pstpu:prefix_index_size gauge",
+        f"pstpu:prefix_index_size{label} {s['prefix_index_size']}",
+        "# HELP pstpu:kv_restore_saved_tokens_total Prompt tokens restored "
+        "from the shared KV tier instead of recomputed (cost-model "
+        "admitted)",
+        "# TYPE pstpu:kv_restore_saved_tokens_total counter",
+        f"pstpu:kv_restore_saved_tokens_total{label} "
+        f"{s['kv_restore_saved_tokens_total']}",
+        "# HELP pstpu:kv_shared_tier_hits_total KV blocks served by the "
+        "shared host/remote tiers during prefill restores",
+        "# TYPE pstpu:kv_shared_tier_hits_total counter",
+        f"pstpu:kv_shared_tier_hits_total{label} "
+        f"{s['kv_shared_tier_hits_total']}",
+        "# HELP pstpu:kv_shared_tier_misses_total Restore-candidate KV "
+        "blocks the shared tiers did not hold",
+        "# TYPE pstpu:kv_shared_tier_misses_total counter",
+        f"pstpu:kv_shared_tier_misses_total{label} "
+        f"{s['kv_shared_tier_misses_total']}",
+        "# HELP pstpu:kv_chain_evictions_total Leaf-first chain evictions "
+        "in the local host KV tier",
+        "# TYPE pstpu:kv_chain_evictions_total counter",
+        f"pstpu:kv_chain_evictions_total{label} "
+        f"{s['kv_chain_evictions_total']}",
         # Two-slot dispatch-pipeline telemetry (engine.py:_run_loop): the
         # prefill/decode overlap win is observable, not asserted.
         "# HELP pstpu:decode_dispatches_total Fused decode dispatches issued",

@@ -49,6 +49,10 @@ class FakeEngine:
         self.prefix_hits = 0
         self.prefix_queries = 0
         self.kv_usage = 0.0
+        # /prefix_index digest (docs/KV_ECONOMY.md): tests inject truncated
+        # block hashes here to simulate device prefix residency.
+        self.prefix_index_entries = []
+        self.prefix_index_block_size = 16
         self.requests_seen = []     # (endpoint, body) tuples for assertions
         self.headers_seen = []      # request headers per completion call
         # ---- fault injection ----
@@ -92,8 +96,19 @@ class FakeEngine:
         app.router.add_get("/v1/models", self.models)
         app.router.add_get("/health", self.health)
         app.router.add_get("/metrics", self.metrics)
+        app.router.add_get("/prefix_index", self.prefix_index)
         app.router.add_post("/fault", self.fault)
         return app
+
+    async def prefix_index(self, request):
+        """Device-resident prefix digest in the real engine's shape
+        (api_server.prefix_index), fed from the injectable attributes."""
+        return web.json_response({
+            "block_size": self.prefix_index_block_size,
+            "model": self.model,
+            "entries": list(self.prefix_index_entries),
+            "truncated": False,
+        })
 
     async def fault(self, request):
         """Cross-process fault injection (soak chaos executor). Real

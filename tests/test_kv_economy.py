@@ -1,0 +1,710 @@
+"""Fleet-scale KV economy (docs/KV_ECONOMY.md): chain-aware shared-tier
+eviction, the batched 'M'/'I' wire ops, restore-over-recompute admission,
+and global prefix-aware routing."""
+
+import asyncio
+import random
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from production_stack_tpu.engine.kv_cache import BlockPoolManager, _block_hash
+from production_stack_tpu.kv_offload.chain_lru import ChainStore
+from production_stack_tpu.kv_offload.manager import (
+    KVOffloadManager,
+    restore_beats_recompute,
+)
+from production_stack_tpu.kv_offload.serde import (
+    pack_block,
+    pack_chain,
+    unpack_block,
+    unpack_chain,
+)
+
+BLOCK_SHAPE = (2, 2, 4, 8)  # [L, Hkv, bs, Dh]
+
+
+# ------------------------------------------------------------- chain store
+def test_chain_store_leaf_first_eviction():
+    s = ChainStore(max_bytes=100)
+    s.put(b"root", b"x" * 30)
+    s.put(b"mid", b"x" * 30, parent=b"root")
+    s.put(b"leaf", b"x" * 30, parent=b"mid")
+    s.put(b"other", b"x" * 30)  # over budget: oldest CHILDLESS goes
+    assert s.contains(b"root") and s.contains(b"mid")
+    assert not s.contains(b"leaf")
+    st = s.stats()
+    assert st["evictions"] == 1 and st["chain_evictions"] == 1
+    assert st["parent_protected_skips"] == 0  # no forced-past-frontier path
+
+
+def test_chain_touch_refreshes_whole_chain():
+    s = ChainStore(max_bytes=90)
+    s.put(b"a1", b"x" * 30)
+    s.put(b"a2", b"x" * 30, parent=b"a1")
+    s.put(b"b1", b"x" * 30)
+    # Touch the a-chain's LEAF: a1 (the parent, older than b1) must be
+    # refreshed too, so the next eviction takes b1.
+    assert s.get(b"a2") is not None
+    s.put(b"c1", b"x" * 30)
+    assert not s.contains(b"b1")
+    assert s.contains(b"a1") and s.contains(b"a2")
+
+
+def test_chain_store_parent_never_evicted_before_children_property():
+    """Randomized workload: puts of root-first chains, random leaf/interior
+    touches, constant eviction pressure. After EVERY operation, every
+    resident entry's declared parent is still resident — the invariant a
+    flat blob-LRU violates."""
+    rng = random.Random(1234)
+    s = ChainStore(max_bytes=40 * 25)  # ~25 entries of 40 bytes
+    chains = []
+
+    def check_invariant():
+        with s._lock:
+            for k in s._data:
+                p = s._parent.get(k)
+                assert p is None or p in s._data, (
+                    f"resident child {k!r} lost its parent {p!r}"
+                )
+            # The incrementally maintained eviction frontier never drifts
+            # from ground truth (resident entries with no resident child).
+            expected = {k for k in s._data if not s._has_live_child(k)}
+            assert set(s._leaves) == expected
+
+    for step in range(400):
+        op = rng.random()
+        if op < 0.5 or not chains:
+            cid = rng.randrange(1000)
+            depth = rng.randint(1, 6)
+            keys = [f"c{cid}-{d}".encode() for d in range(depth)]
+            for d, key in enumerate(keys):  # root-first, the spiller order
+                s.put(key, b"x" * 40, parent=keys[d - 1] if d else None)
+                check_invariant()
+            chains.append(keys)
+        else:
+            keys = rng.choice(chains)
+            s.get(rng.choice(keys))
+            check_invariant()
+    assert s.stats()["evictions"] > 50  # pressure was real
+
+
+def test_chain_store_deep_chain_overflow_stays_bounded_and_contiguous():
+    """A chain deeper than the whole tier self-trims: the byte budget
+    holds, the parent-protection invariant is never violated mid-put, and
+    what survives is one contiguous segment of the chain (never holes —
+    holes would be unrestorable dead weight)."""
+    s = ChainStore(max_bytes=100)
+    keys = [f"k{d}".encode() for d in range(8)]
+    for d, key in enumerate(keys):
+        s.put(key, b"x" * 30, parent=keys[d - 1] if d else None)
+        assert s.stats()["bytes"] <= 100
+    resident = [d for d, k in enumerate(keys) if s.contains(k)]
+    assert resident == list(range(resident[0], resident[-1] + 1))
+    assert len(resident) == 3
+
+
+# ------------------------------------------------------------------- serde
+def test_chain_envelope_roundtrip_and_passthrough():
+    k = np.arange(np.prod(BLOCK_SHAPE), dtype=np.float32).reshape(BLOCK_SHAPE)
+    inner = pack_block(k, k * 2)
+    parent, payload = unpack_chain(pack_chain(b"q8|parenthash0123", inner))
+    assert parent == b"q8|parenthash0123"
+    k2, v2, ks2, vs2 = unpack_block(payload)
+    np.testing.assert_array_equal(k2, k)
+    np.testing.assert_array_equal(v2, k * 2)
+    # Bare PKV1/PKV2 blobs (pre-chain stores) pass through untouched.
+    assert unpack_chain(inner) == (b"", inner)
+    # Chain roots carry an empty parent.
+    assert unpack_chain(pack_chain(b"", inner)) == (b"", inner[:]) or True
+    p, body = unpack_chain(pack_chain(b"", inner))
+    assert p == b"" and body == inner
+
+
+# --------------------------------------------------------------- wire ops
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture
+def kv_server():
+    """Python cache server on a background loop; yields its kv:// URL."""
+    from production_stack_tpu.kv_offload.server import serve_python
+
+    port = _free_port()
+    loop = asyncio.new_event_loop()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        try:
+            loop.run_until_complete(serve_python("127.0.0.1", port, 1 << 20))
+        except asyncio.CancelledError:
+            pass
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port), 0.2).close()
+            break
+        except OSError:
+            time.sleep(0.05)
+    yield f"kv://127.0.0.1:{port}"
+    loop.call_soon_threadsafe(loop.stop)
+
+
+def test_wire_multi_get_and_index_query(kv_server):
+    from production_stack_tpu.kv_offload.remote import RemoteKVClient
+
+    c = RemoteKVClient(kv_server)
+    c.put(b"k1", pack_chain(b"", b"blob1"))
+    c.put(b"k2", pack_chain(b"k1", b"blob2"))
+    rt0 = c.round_trips
+    got = c.multi_get([b"k1", b"k2", b"missing"])
+    assert [unpack_chain(g)[1] if g else None for g in got] == [
+        b"blob1", b"blob2", None,
+    ]
+    assert c.index_query([b"k2", b"zz", b"k1"]) == [True, False, True]
+    assert c.round_trips - rt0 == 2  # one per batched op
+    # 'I' must not refresh recency; 'M'/'G' must. Chain eviction metadata
+    # also survives the wire: the server learned k1 is k2's parent.
+    stats = c.stats()
+    assert stats["entries"] == 2 and stats["hits"] >= 2
+    c.close()
+
+
+def test_wire_mixed_dtype_namespacing(kv_server):
+    """q8|-prefixed and bare keys are disjoint store entries."""
+    from production_stack_tpu.kv_offload.remote import RemoteKVClient
+
+    c = RemoteKVClient(kv_server)
+    h = b"\x01" * 16
+    c.put(h, b"bf16blob")
+    c.put(b"q8|" + h, b"int8blob")
+    assert c.get(h) == b"bf16blob"
+    assert c.get(b"q8|" + h) == b"int8blob"
+    assert c.index_query([h, b"q8|" + h, b"q8|" + b"\x02" * 16]) == [
+        True, True, False,
+    ]
+    c.close()
+
+
+def test_batched_ops_degrade_to_per_key(kv_server):
+    """A server that rejects 'M'/'I' (the native C++ binary) degrades to
+    per-key get/exists loops instead of failing."""
+    from production_stack_tpu.kv_offload.remote import RemoteKVClient
+
+    c = RemoteKVClient(kv_server)
+    c.put(b"k1", b"v1")
+    c._batched_ops_ok = False  # what a STATUS_ERROR answer records
+    assert c.multi_get([b"k1", b"nope"]) == [b"v1", None]
+    assert c.index_query([b"k1", b"nope"]) == [True, False]
+    c.close()
+
+
+# ------------------------------------------------- restore-over-recompute
+def test_restore_cost_model():
+    # A 1000-token prefix at modest KV bytes over a 2 GB/s link: restore.
+    assert restore_beats_recompute(1000, 2048, 2.0, 4000)
+    # Recompute wins when the link is slow relative to prefill * bytes.
+    assert not restore_beats_recompute(1000, 2_000_000, 0.1, 100_000)
+    # Degenerate knobs disable the model (always restore).
+    assert restore_beats_recompute(64, 0, 2.0, 4000)
+    assert restore_beats_recompute(64, 2048, 0, 4000)
+    assert not restore_beats_recompute(0, 2048, 2.0, 4000)
+    # Host-resident blocks are free RAM copies: a run whose bytes would
+    # lose on the link still restores when nothing crosses it, and only
+    # the remote subset is charged.
+    assert restore_beats_recompute(1000, 2_000_000, 0.1, 100_000,
+                                   transfer_tokens=0)
+    assert not restore_beats_recompute(1000, 2_000_000, 0.1, 100_000,
+                                       transfer_tokens=1000)
+    assert restore_beats_recompute(1000, 2_000_000, 2.0, 1000,
+                                   transfer_tokens=16)
+
+
+class _FakeRunner:
+    """Minimal runner for KVOffloadManager: records block writes."""
+
+    kv_quantized = False
+
+    def __init__(self):
+        self.writes = []
+
+    def write_blocks(self, blks, k, v, ks=None, vs=None):
+        self.writes.append((list(blks), np.asarray(k), np.asarray(v)))
+
+    def read_blocks_retry(self, blks):
+        n = len(blks)
+        shape = (n,) + BLOCK_SHAPE
+        return (np.zeros(shape, np.float32), np.zeros(shape, np.float32),
+                None, None)
+
+
+def _chain_blobs(token_ids, bs, key_prefix=b""):
+    """(keys, blobs, arrays) for every full block of ``token_ids``,
+    chain-enveloped exactly like the spiller writes them."""
+    prev = b""
+    keys, blobs, arrays = [], [], []
+    n_full = (len(token_ids) - 1) // bs
+    for i in range(n_full):
+        h = _block_hash(prev, token_ids[i * bs:(i + 1) * bs])
+        k = np.full(BLOCK_SHAPE, i + 1, np.float32)
+        v = np.full(BLOCK_SHAPE, -(i + 1), np.float32)
+        parent_key = key_prefix + prev if prev else b""
+        keys.append(key_prefix + h)
+        blobs.append(pack_chain(parent_key, pack_block(k, v)))
+        arrays.append((k, v))
+        prev = h
+    return keys, blobs, arrays
+
+
+def test_restore_uses_two_round_trips(kv_server):
+    """N remote-resident blocks restore in <= 2 round trips ('I' + 'M'),
+    not one 'G' per block — the satellite's efficiency bar."""
+    bs = 4
+    token_ids = list(range(100, 133))  # 33 tokens -> 8 full blocks
+    runner = _FakeRunner()
+    bm = BlockPoolManager(num_blocks=64, block_size=bs)
+    mgr = KVOffloadManager(runner, bm, host_pool_bytes=0,
+                           remote_url=kv_server)
+    try:
+        keys, blobs, arrays = _chain_blobs(token_ids, bs)
+        for key, blob in zip(keys, blobs):
+            assert mgr.remote.put(key, blob)
+        rt0 = mgr.remote.round_trips
+        restored = mgr.try_restore(token_ids, list(range(1, 10)), 0)
+        assert restored == 8 * bs
+        assert mgr.remote.round_trips - rt0 <= 2
+        assert mgr.restore_saved_tokens_total == restored
+        assert mgr.shared_tier_hits_total == 8
+        # Restored bytes are bit-identical to what was published.
+        (blks, k_np, v_np), = runner.writes
+        assert blks == list(range(1, 9))
+        np.testing.assert_array_equal(k_np[3], arrays[3][0])
+        np.testing.assert_array_equal(v_np[5], arrays[5][1])
+        # The device prefix counters advanced (router-visible hit rate).
+        assert bm.prefix_hits_total == restored
+    finally:
+        mgr.close()
+
+
+def test_restore_partial_residency_and_bare_pkv1(kv_server):
+    """A chain resident only up to depth D restores exactly D blocks, and
+    pre-chain bare PKV1 blobs (no PKC1 envelope) still decode."""
+    bs = 4
+    token_ids = list(range(200, 229))  # 29 tokens -> 7 full blocks
+    runner = _FakeRunner()
+    bm = BlockPoolManager(num_blocks=64, block_size=bs)
+    mgr = KVOffloadManager(runner, bm, host_pool_bytes=0,
+                           remote_url=kv_server)
+    try:
+        keys, blobs, _ = _chain_blobs(token_ids, bs)
+        # Store only the first 3 blocks; block 0 as a BARE PKV1 blob.
+        _, bare = unpack_chain(blobs[0])
+        assert mgr.remote.put(keys[0], bare)
+        for key, blob in zip(keys[1:3], blobs[1:3]):
+            assert mgr.remote.put(key, blob)
+        restored = mgr.try_restore(token_ids, list(range(1, 9)), 0)
+        assert restored == 3 * bs
+        assert mgr.shared_tier_misses_total == 4
+    finally:
+        mgr.close()
+
+
+def test_restore_declined_by_cost_model(kv_server):
+    bs = 4
+    token_ids = list(range(300, 317))  # 4 full blocks
+    runner = _FakeRunner()
+    bm = BlockPoolManager(num_blocks=64, block_size=bs)
+    mgr = KVOffloadManager(
+        runner, bm, host_pool_bytes=0, remote_url=kv_server,
+        bytes_per_token=2_000_000, link_gbps=0.1, prefill_tok_s=100_000,
+    )
+    try:
+        keys, blobs, _ = _chain_blobs(token_ids, bs)
+        for key, blob in zip(keys, blobs):
+            assert mgr.remote.put(key, blob)
+        assert mgr.try_restore(token_ids, list(range(1, 6)), 0) == 0
+        assert mgr.restore_declined_tokens_total == 4 * bs
+        assert runner.writes == []
+    finally:
+        mgr.close()
+
+
+def test_quantized_manager_never_splices_bf16_store(kv_server):
+    """An int8 engine ('q8|' namespace) must not restore bare-key bf16
+    blobs even if the hashes match."""
+    bs = 4
+    token_ids = list(range(400, 417))
+
+    class _QuantRunner(_FakeRunner):
+        kv_quantized = True
+
+    runner = _QuantRunner()
+    bm = BlockPoolManager(num_blocks=64, block_size=bs)
+    mgr = KVOffloadManager(runner, bm, host_pool_bytes=0,
+                           remote_url=kv_server)
+    try:
+        keys, blobs, _ = _chain_blobs(token_ids, bs)  # BARE keys (bf16)
+        for key, blob in zip(keys, blobs):
+            assert mgr.remote.put(key, blob)
+        assert mgr.try_restore(token_ids, list(range(1, 6)), 0) == 0
+        assert runner.writes == []
+    finally:
+        mgr.close()
+
+
+# -------------------------------------------------------- spill chain links
+def test_spiller_publishes_chain_links(kv_server):
+    """Blocks spilled by the manager carry their parent's store key, and
+    the server rebuilds the chain (leaf-first eviction metadata)."""
+    bs = 4
+
+    class _Runner(_FakeRunner):
+        def read_blocks_retry(self, blks):
+            n = len(blks)
+            shape = (n,) + BLOCK_SHAPE
+            k = np.stack([np.full(BLOCK_SHAPE, b, np.float32) for b in blks])
+            return k, np.zeros(shape, np.float32), None, None
+
+    runner = _Runner()
+    bm = BlockPoolManager(num_blocks=64, block_size=bs)
+    mgr = KVOffloadManager(runner, bm, host_pool_bytes=1 << 20,
+                           remote_url=kv_server, flush_interval=0.02)
+    try:
+        # Register a 3-block chain like prefill does, then let it spill.
+        blocks = bm.allocate_blocks(3)
+        prev = b""
+        hashes = []
+        for i, blk in enumerate(blocks):
+            h = bm.register_full_block(
+                blk, prev, list(range(i * bs, (i + 1) * bs))
+            )
+            hashes.append(h)
+            mgr.on_block_registered(h, blk)
+            prev = h
+        deadline = time.time() + 5
+        while time.time() < deadline and mgr.spilled_blocks_total < 3:
+            time.sleep(0.05)
+        assert mgr.spilled_blocks_total == 3
+        # Remote tier: the enveloped blobs declare their parents.
+        blob1 = mgr.remote.get(hashes[1])
+        parent_key, _ = unpack_chain(blob1)
+        assert parent_key == hashes[0]
+        # Local tier: same chain structure.
+        assert mgr.host_pool._store.parent_of(hashes[2]) == hashes[1]
+    finally:
+        mgr.close()
+
+
+# ------------------------------------------------------- prefix-aware router
+class _FakeReq:
+    def __init__(self, headers=None, json_body=None):
+        self.headers = headers or {}
+        self.json_body = json_body or {}
+
+
+class _Tok:
+    def encode(self, text, **_):
+        return list(text.encode())
+
+    def apply_chat_template(self, messages, add_generation_prompt=True, **_):
+        parts = [f"<|{m['role']}|>\n{m['content']}\n" for m in messages]
+        if add_generation_prompt:
+            parts.append("<|assistant|>\n")
+        return "".join(parts)
+
+
+def _fresh_prefix_router(**kwargs):
+    from production_stack_tpu.router.routing_logic import PrefixAwareRouter
+
+    r = PrefixAwareRouter.__new__(PrefixAwareRouter)  # bypass singleton
+    r.__init__(**kwargs)
+    return r
+
+
+def _eps(*urls):
+    from production_stack_tpu.router.service_discovery import EndpointInfo
+
+    return [EndpointInfo(url=u, model_names=["m"]) for u in urls]
+
+
+def _digest_for(text, bs):
+    from production_stack_tpu.router.stats.engine_stats import (
+        PrefixIndexSnapshot,
+    )
+
+    ids = list(text.encode())
+    prev, entries = b"", []
+    for i in range((len(ids) - 1) // bs):
+        prev = _block_hash(prev, ids[i * bs:(i + 1) * bs])
+        entries.append(prev.hex()[:16])
+    return PrefixIndexSnapshot(
+        block_size=bs, entries=frozenset(entries), scraped_at=time.time()
+    )
+
+
+def test_prefix_router_routes_to_warm_engine():
+    from production_stack_tpu.router.stats.engine_stats import (
+        PrefixIndexSnapshot,
+    )
+
+    prompt = "shared system prompt, long enough for many blocks " * 4
+    idx = {
+        "http://warm": _digest_for(prompt, 16),
+        "http://cold": PrefixIndexSnapshot(
+            block_size=16, entries=frozenset(), scraped_at=time.time()
+        ),
+    }
+    r = _fresh_prefix_router(
+        session_key="x-user-id", prefix_tokenizer=_Tok(),
+        index_provider=lambda: idx,
+    )
+    for _ in range(3):
+        url = r.route_request(
+            _eps("http://cold", "http://warm"), {}, {},
+            _FakeReq(headers={"x-user-id": "u1"},
+                     json_body={"prompt": prompt}),
+        )
+        assert url == "http://warm"
+    assert r.routed_by_index == 3
+
+
+def test_prefix_router_score_blends_load():
+    """A tiny match on a saturated engine loses to an idle engine."""
+    from production_stack_tpu.router.stats.engine_stats import (
+        EngineStats,
+        PrefixIndexSnapshot,
+    )
+
+    prompt = "x" * 400
+    full = _digest_for(prompt, 16)
+    one_block = PrefixIndexSnapshot(
+        block_size=16, entries=frozenset(list(full.entries)[:1]),
+        scraped_at=time.time(),
+    )
+    idx = {"http://warm": _digest_for(prompt, 16)}
+    # Recompute one_block as the FIRST chain hash specifically.
+    ids = list(prompt.encode())
+    h0 = _block_hash(b"", ids[:16]).hex()[:16]
+    idx["http://warm"] = PrefixIndexSnapshot(
+        block_size=16, entries=frozenset([h0]), scraped_at=time.time()
+    )
+    stats = {
+        "http://warm": EngineStats(num_running_requests=64,
+                                   num_queuing_requests=32,
+                                   gpu_cache_usage_perc=1.0),
+        "http://cold": EngineStats(),
+    }
+    r = _fresh_prefix_router(prefix_tokenizer=_Tok(),
+                             index_provider=lambda: idx)
+    url = r.route_request(_eps("http://cold", "http://warm"), stats, {},
+                          _FakeReq(json_body={"prompt": prompt}))
+    assert url == "http://cold"
+
+
+def test_prefix_router_stale_index_falls_back():
+    prompt = "stale index prompt " * 10
+    snap = _digest_for(prompt, 16)
+    stale = type(snap)(block_size=16, entries=snap.entries,
+                      scraped_at=time.time() - 3600)
+    r = _fresh_prefix_router(prefix_tokenizer=_Tok(),
+                             index_provider=lambda: {"http://a": stale})
+    url = r.route_request(_eps("http://a", "http://b"), {}, {},
+                          _FakeReq(json_body={"prompt": prompt}))
+    assert url in ("http://a", "http://b")
+    assert r.routed_by_index == 0 and r.routed_by_fallback == 1
+
+
+def test_prefix_router_tier_fallback_and_kv_down_cooldown():
+    """No device residency + tier-resident chain head -> least-loaded; a
+    dead kv server trips the cooldown instead of being re-dialed."""
+    from production_stack_tpu.router.stats.engine_stats import EngineStats
+
+    prompt = "tier resident prompt " * 8
+
+    class _TierClient:
+        def __init__(self, fail=False):
+            self.fail = fail
+            self.calls = 0
+
+        def index_query(self, keys):
+            self.calls += 1
+            if self.fail:
+                raise ConnectionError("kv server down")
+            # Bare-namespace keys resident, q8| not.
+            return [not k.startswith(b"q8|") for k in keys]
+
+    stats = {"http://a": EngineStats(num_running_requests=32),
+             "http://b": EngineStats()}
+    tier = _TierClient()
+    r = _fresh_prefix_router(prefix_tokenizer=_Tok(),
+                             index_provider=lambda: {},
+                             kv_client=tier)
+    url = r.route_request(_eps("http://a", "http://b"), stats, {},
+                          _FakeReq(json_body={"prompt": prompt}))
+    assert url == "http://b" and r.routed_by_tier == 1
+
+    down = _TierClient(fail=True)
+    r2 = _fresh_prefix_router(prefix_tokenizer=_Tok(),
+                              index_provider=lambda: {},
+                              kv_client=down)
+    for _ in range(3):
+        r2.route_request(_eps("http://a", "http://b"), stats, {},
+                         _FakeReq(json_body={"prompt": prompt}))
+    assert down.calls == 1          # cooldown prevented re-dials
+    assert r2.routed_by_fallback == 3
+
+
+def test_prefix_router_session_affinity_last_rung():
+    r = _fresh_prefix_router(session_key="x-user-id",
+                             index_provider=lambda: {})
+    eps = _eps("http://a", "http://b")
+    req = _FakeReq(headers={"x-user-id": "sticky"},
+                   json_body={"messages": [{"role": "user", "content": "q"}]})
+    first = r.route_request(eps, {}, {}, req)
+    for _ in range(4):
+        assert r.route_request(eps, {}, {}, req) == first
+
+
+def test_prefix_router_token_id_prompts_need_no_tokenizer():
+    """Without --prefix-tokenizer, token-id prompts still hash + match."""
+    ids = list(range(1, 70))
+    from production_stack_tpu.router.stats.engine_stats import (
+        PrefixIndexSnapshot,
+    )
+
+    prev, entries = b"", []
+    for i in range((len(ids) - 1) // 16):
+        prev = _block_hash(prev, ids[i * 16:(i + 1) * 16])
+        entries.append(prev.hex()[:16])
+    idx = {"http://warm": PrefixIndexSnapshot(
+        block_size=16, entries=frozenset(entries), scraped_at=time.time()
+    )}
+    r = _fresh_prefix_router(index_provider=lambda: idx)
+    url = r.route_request(_eps("http://cold", "http://warm"), {}, {},
+                          _FakeReq(json_body={"prompt": ids}))
+    assert url == "http://warm" and r.routed_by_index == 1
+
+
+# ------------------------------------------------------------ metrics export
+def test_kv_economy_metrics_render():
+    from production_stack_tpu.server.metrics import render_engine_metrics
+
+    class _E:
+        def stats(self):
+            return {
+                "num_requests_running": 0, "num_requests_waiting": 0,
+                "kv_cache_usage": 0.0, "prefix_cache_hits": 0,
+                "prefix_cache_queries": 0, "num_preemptions": 0,
+                "prompt_tokens_total": 0, "generation_tokens_total": 0,
+                "prefix_index_size": 7,
+                "kv_restore_saved_tokens_total": 128,
+                "kv_shared_tier_hits_total": 8,
+                "kv_shared_tier_misses_total": 3,
+                "kv_chain_evictions_total": 2,
+            }
+
+    text = render_engine_metrics(_E(), "m")
+    assert 'pstpu:prefix_index_size{model_name="m"} 7' in text
+    assert 'pstpu:kv_restore_saved_tokens_total{model_name="m"} 128' in text
+    assert 'pstpu:kv_shared_tier_hits_total{model_name="m"} 8' in text
+    assert 'pstpu:kv_shared_tier_misses_total{model_name="m"} 3' in text
+    assert 'pstpu:kv_chain_evictions_total{model_name="m"} 2' in text
+
+
+# --------------------------------------------------------------- 2-engine e2e
+async def test_e2e_prefix_aware_routes_to_warm_engine():
+    """Real router app + two fake engines: the engine whose /prefix_index
+    digest holds the prompt's chain gets the traffic; unknown prompts
+    fall back to load balancing (docs/KV_ECONOMY.md e2e bar)."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from production_stack_tpu.router.app import build_app
+    from production_stack_tpu.router.stats.engine_stats import (
+        get_engine_stats_scraper,
+    )
+    from tests.fake_engine import FakeEngine
+    from tests.test_router_e2e import router_args
+
+    prompt = "kv economy shared prefix " * 10  # 250 chars, 15 full blocks
+    bs = 16
+    ids = list(prompt.encode())
+    prev, entries = b"", []
+    for i in range((len(ids) - 1) // bs):
+        prev = _block_hash(prev, ids[i * bs:(i + 1) * bs])
+        entries.append(prev.hex()[:16])
+
+    engines, servers = [], []
+    for i in range(2):
+        eng = FakeEngine(model="m1", speed=5000.0)
+        eng.prefix_index_block_size = bs
+        srv = TestServer(eng.build_app())
+        await srv.start_server()
+        engines.append(eng)
+        servers.append(srv)
+    urls = [f"http://127.0.0.1:{s.port}" for s in servers]
+    warm, cold = engines[1], engines[0]
+    warm.prefix_index_entries = entries
+
+    args = router_args(
+        urls, ["m1", "m1"], routing="prefix-aware",
+        session_key="x-user-id", engine_stats_interval=0.2,
+        prefix_tokenizer="tiny-llama", kv_offload_url=None,
+        prefix_weight=1.0, prefix_load_weight=0.5,
+    )
+    app = build_app(args)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        # Wait for the scraper's first /prefix_index pass.
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            idx = get_engine_stats_scraper().get_prefix_index()
+            if any(s.entries for s in idx.values()):
+                break
+            await asyncio.sleep(0.05)
+        else:
+            pytest.fail("scraper never picked up the prefix index")
+
+        for _ in range(3):
+            resp = await client.post("/v1/completions", json={
+                "model": "m1", "prompt": prompt, "max_tokens": 3,
+            })
+            assert resp.status == 200
+            await resp.read()
+        warm_urlidx = urls.index(f"http://127.0.0.1:{servers[1].port}")
+        assert len(warm.requests_seen) == 3, (
+            f"warm engine saw {len(warm.requests_seen)}, "
+            f"cold saw {len(cold.requests_seen)} (warm idx {warm_urlidx})"
+        )
+        assert len(cold.requests_seen) == 0
+
+        # A prompt resident nowhere load-balances instead of erroring.
+        resp = await client.post("/v1/completions", json={
+            "model": "m1", "prompt": "completely different text " * 10,
+            "max_tokens": 3,
+        })
+        assert resp.status == 200
+        await resp.read()
+        assert len(warm.requests_seen) + len(cold.requests_seen) == 4
+
+        # Satellite: the router exports the per-backend scraped hit rate
+        # and prefix-index size, labelled by server.
+        mresp = await client.get("/metrics")
+        mtext = await mresp.text()
+        assert "router_backend_kv_hit_rate{" in mtext
+        assert "router_prefix_index_entries{" in mtext
+    finally:
+        await client.close()
+        for s in servers:
+            await s.close()

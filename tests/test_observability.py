@@ -77,6 +77,16 @@ def test_dashboard_queries_name_exported_series():
                 f"{missing}; exported: {sorted(exported)}"
             )
     assert n_targets >= 12
+    # KV-economy panels (docs/KV_ECONOMY.md): shared-tier hit rate and the
+    # router's measured per-backend hit rate are charted, not just
+    # exported.
+    all_series = set()
+    for panel in dash["panels"]:
+        for target in panel.get("targets", []):
+            all_series |= _metric_names(target["expr"])
+    assert {"pstpu:kv_shared_tier_hits_total",
+            "pstpu:kv_shared_tier_misses_total",
+            "router_backend_kv_hit_rate"} <= all_series
 
 
 def test_prom_adapter_rule_names_exported_series():
@@ -96,6 +106,10 @@ def test_prom_adapter_rule_names_exported_series():
     # these rules (docs/SOAK.md: values-only autoscaling wiring).
     served = {r["name"]["as"] for r in rules}
     assert {"pstpu_queue_depth", "router_queue_depth"} <= served
+    # KV-economy rules (docs/KV_ECONOMY.md): the router's measured
+    # per-backend hit rate and the shared-tier hit counter.
+    assert {"router_backend_kv_hit_rate",
+            "pstpu_kv_shared_tier_hits_total"} <= served
 
 
 def test_latency_histograms_scrape():

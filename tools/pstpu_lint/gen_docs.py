@@ -31,6 +31,7 @@ TABLES = {
     "disagg": "docs/DISAGG.md",
     "resilience": "docs/RESILIENCE.md",
     "autoscaling": "docs/SOAK.md",
+    "kv-economy": "docs/KV_ECONOMY.md",
 }
 
 FLAG_TABLES = {

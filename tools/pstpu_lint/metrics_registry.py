@@ -116,6 +116,25 @@ REGISTRY: Tuple[Series, ...] = (
            _BOTH_ENGINE, ("catalogue", "dispatch"),
            "KV-pool bytes the quantized cache avoided writing vs the "
            "compute dtype"),
+    # ------------------------------------------- engine: KV economy
+    Series("pstpu:prefix_index_size", "gauge", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "kv-economy"),
+           "Content-addressed blocks resident in the device prefix cache "
+           "(the /prefix_index digest size)"),
+    Series("pstpu:kv_restore_saved_tokens_total", "counter", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "kv-economy"),
+           "Prompt tokens restored from the shared KV tier instead of "
+           "recomputed (cost-model admitted)"),
+    Series("pstpu:kv_shared_tier_hits_total", "counter", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "kv-economy"),
+           "KV blocks served by the shared host/remote tiers during "
+           "prefill restores"),
+    Series("pstpu:kv_shared_tier_misses_total", "counter", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "kv-economy"),
+           "Restore-candidate KV blocks the shared tiers did not hold"),
+    Series("pstpu:kv_chain_evictions_total", "counter", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "kv-economy"),
+           "Leaf-first chain evictions in the local host KV tier"),
     Series("pstpu:disagg_role", "gauge", ("model_name", "role"),
            _BOTH_ENGINE, ("catalogue", "disagg"),
            "Engine disaggregation role (1 = active)"),
@@ -218,6 +237,15 @@ REGISTRY: Tuple[Series, ...] = (
            "Rolling-window fraction of x-slo-class requests meeting their "
            "soft TTFT target",
            router_labels=("slo_class",)),
+    # ------------------------------------------------ router: KV economy
+    Series("router_backend_kv_hit_rate", "gauge", (), (ROUTER,),
+           ("catalogue", "kv-economy"),
+           "Per-interval prefix-cache hit rate per backend (scrape plane)",
+           router_labels=("server",)),
+    Series("router_prefix_index_entries", "gauge", (), (ROUTER,),
+           ("catalogue", "kv-economy"),
+           "Entries in the backend's last scraped /prefix_index digest",
+           router_labels=("server",)),
     Series("router_disagg_handoffs_total", "counter", (), (ROUTER,),
            ("catalogue", "disagg"),
            "Prefill->decode handoffs completed through the two-hop flow",
