@@ -544,6 +544,11 @@ def main():
     ap.add_argument("--soak-max-queue-len", type=int, default=32,
                     help="engine admission bound during the soak (shed "
                          "with 503+Retry-After beyond it)")
+    ap.add_argument("--soak-require-zero-truncation", action="store_true",
+                    help="fail the soak unless EVERY stream ended in "
+                         "data:[DONE] — mid-stream engine kills must be "
+                         "resumed, not truncated (docs/RESILIENCE.md; "
+                         "pair with a kill_engine fault)")
     ap.add_argument("--soak-output", default=None,
                     help="write the soak report JSON here (e.g. "
                          "BENCH_soak_r01.json) in addition to stdout")
@@ -577,7 +582,10 @@ def main():
             with open(args.soak_output, "w") as f:
                 json.dump(report, f, indent=1)
                 f.write("\n")
-        assert_soak_bars(report, args.soak_max_recovery)
+        assert_soak_bars(
+            report, args.soak_max_recovery,
+            require_zero_truncation=args.soak_require_zero_truncation,
+        )
         return 0
 
     if args.disagg:

@@ -107,6 +107,11 @@ class RequestRecord:
     retry_after: bool = False  # terminal 503 carried Retry-After (shed, not
                                # error — docs/SOAK.md accounting)
     slo_class: str = ""
+    # A 200 SSE stream that ended WITHOUT data:[DONE]: the client kept its
+    # status but lost the tail of the answer (status is forced to 599 so
+    # the zero-5xx gate sees it too; this flag feeds the explicit
+    # zero-truncation gate, docs/RESILIENCE.md).
+    truncated: bool = False
 
     @property
     def ok(self) -> bool:
@@ -199,6 +204,7 @@ class UserSession:
         status = 599               # transport error unless a response lands
         retry_after_hdr: Optional[str] = None
         sheds = 0
+        truncated = False
         while True:
             try:
                 async with http.post(
@@ -250,16 +256,22 @@ class UserSession:
                     if not saw_done:
                         # Stream ended without the terminal sentinel: a
                         # mid-stream truncation (backend died after bytes
-                        # were on the wire — truncation-only semantics,
+                        # were on the wire and no resume spliced the tail —
                         # docs/RESILIENCE.md). The client saw a broken
                         # answer, so it counts as an error, not a 200 —
                         # otherwise the soak's zero-5xx gate would be
-                        # blind to hard mid-stream kills.
+                        # blind to hard mid-stream kills. The explicit flag
+                        # feeds the zero-truncation gate.
                         status = 599
+                        truncated = True
                     break
             except aiohttp.ClientResponseError:
                 raise              # raise_on_error path (status preserved)
             except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+                if status == 200:
+                    # The 200 stream had begun; the transport died before
+                    # [DONE] — a truncation, same as the clean-EOF case.
+                    truncated = True
                 status = 599       # transport failure — always an error
                 retry_after_hdr = None
                 if cfg.raise_on_error:
@@ -280,6 +292,7 @@ class UserSession:
             status=status, sheds=sheds,
             retry_after=retry_after_hdr is not None,
             slo_class=cfg.slo_class,
+            truncated=truncated,
         ))
 
     async def run(self, http: aiohttp.ClientSession, start_delay: float,

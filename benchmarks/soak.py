@@ -35,9 +35,13 @@ SCHEMA = "pstpu-soak-v1"
 #: Fault actions the chaos executor understands. ``degrade_engine`` /
 #: ``heal_engine`` require the target to serve POST /fault (the fake
 #: engine does; real engines answer 404 and the fault is recorded as
-#: skipped, never a soak failure).
+#: skipped, never a soak failure). ``kill_engine`` is SIGKILL with NO
+#: drain — in-flight streams die mid-byte, the fault class the router's
+#: mid-stream resume (docs/RESILIENCE.md) must absorb for the
+#: zero-truncation bar to hold.
 FAULT_ACTIONS = (
     "restart_engine", "restart_kv_server", "degrade_engine", "heal_engine",
+    "kill_engine",
 )
 
 #: Router gauges the autoscaler wiring targets (docs/SOAK.md); the soak
@@ -208,6 +212,11 @@ def class_summary(records, slo: SLOClass, duration_s: float) -> dict:
         "shed_retries": shed_retries,
         "errors": errors,
         "status_5xx": status_5xx(records),
+        # Streams that ended without data:[DONE] — the zero-truncation
+        # gate's input (docs/RESILIENCE.md mid-stream resume bar).
+        "truncated": sum(
+            1 for r in records if getattr(r, "truncated", False)
+        ),
         "attainment": (len(met) / served_or_failed
                        if served_or_failed else None),
         "p50_ttft_s": percentile(ttfts, 0.50),
@@ -258,12 +267,13 @@ def recovery_time(records, fault_at: float,
 REPORT_REQUIRED_KEYS = (
     "schema", "metric", "model", "backend", "num_engines", "slo_classes",
     "ladder", "faults", "faults_scheduled", "totals", "zero_5xx",
-    "autoscaler_gauges",
+    "zero_truncation", "midstream_resumes", "autoscaler_gauges",
 )
 RUNG_REQUIRED_KEYS = ("qps", "duration_s", "users", "capped_classes",
                       "classes")
 CLASS_REQUIRED_KEYS = (
     "requests", "ok", "met", "shed", "shed_retries", "errors", "status_5xx",
+    "truncated",
     "attainment", "p50_ttft_s", "p99_ttft_s", "p99_itl_s", "output_tok_s",
     "goodput_tok_s", "slo",
 )
@@ -305,9 +315,11 @@ def build_report(*, model: str, backend: str, num_engines: int,
                  faults: List[dict], autoscaler_gauges: Dict[str, bool],
                  slo_attainment_gauge: Optional[Dict[str, float]] = None,
                  faults_scheduled: Optional[int] = None,
+                 midstream_resumes: Optional[Dict[str, float]] = None,
                  ) -> dict:
     """Assemble + validate the soak report (pure; tests feed it synthetic
-    rung/fault data)."""
+    rung/fault data). ``midstream_resumes`` is the router's
+    router_midstream_resumes_total values by outcome, scraped at soak end."""
     all_class = [c for rung in rungs for c in rung["classes"].values()]
     totals = {
         "requests": sum(c["requests"] for c in all_class),
@@ -316,6 +328,7 @@ def build_report(*, model: str, backend: str, num_engines: int,
         "shed_retries": sum(c["shed_retries"] for c in all_class),
         "errors": sum(c["errors"] for c in all_class),
         "status_5xx": sum(c["status_5xx"] for c in all_class),
+        "truncations": sum(c.get("truncated", 0) for c in all_class),
     }
     report = {
         "schema": SCHEMA,
@@ -338,6 +351,11 @@ def build_report(*, model: str, backend: str, num_engines: int,
                              else faults_scheduled),
         "totals": totals,
         "zero_5xx": totals["status_5xx"] == 0 and totals["errors"] == 0,
+        # Zero-truncation bar (docs/RESILIENCE.md): every client stream
+        # ended in data:[DONE] — mid-stream engine deaths were resumed,
+        # not truncated.
+        "zero_truncation": totals["truncations"] == 0,
+        "midstream_resumes": midstream_resumes or {},
         "autoscaler_gauges": autoscaler_gauges,
         "router_slo_attainment": slo_attainment_gauge or {},
     }
@@ -350,12 +368,26 @@ class SoakViolation(AssertionError):
     recovery exceeded the bound."""
 
 
-def assert_soak_bars(report: dict, max_recovery_s: float) -> None:
+def assert_soak_bars(report: dict, max_recovery_s: float,
+                     require_zero_truncation: bool = False) -> None:
     """The chaos-gate acceptance bars (CI soak-smoke fails on these):
     zero client-visible 5xx/transport errors end-to-end, every SCHEDULED
     fault actually injected (a failed or dropped injection must not turn
     the gate green by injecting no chaos at all), and every injected
-    fault recovered within ``max_recovery_s``."""
+    fault recovered within ``max_recovery_s``.
+
+    ``require_zero_truncation`` additionally enforces the mid-stream
+    resume bar (docs/RESILIENCE.md): EVERY client stream ended in
+    data:[DONE] — an engine SIGKILL mid-stream must have been spliced
+    into a resumed continuation, not truncated. Opt-in because it is only
+    meaningful with >= 2 engines and resume enabled."""
+    if require_zero_truncation and not report.get("zero_truncation", True):
+        raise SoakViolation(
+            f"zero-truncation bar violated: "
+            f"{report['totals'].get('truncations')} stream(s) ended "
+            f"without data:[DONE] (midstream_resumes: "
+            f"{report.get('midstream_resumes')})"
+        )
     if not report["zero_5xx"]:
         raise SoakViolation(
             f"zero-5xx bar violated: {report['totals']['status_5xx']} 5xx, "
@@ -558,6 +590,30 @@ def parse_autoscaler_gauges(metrics_text: str) -> Dict[str, bool]:
     return present
 
 
+def parse_midstream_resumes(metrics_text: str) -> Dict[str, float]:
+    """router_midstream_resumes_total{outcome="..."} and
+    router_truncations_total from exposition text — the soak report's
+    evidence that an engine SIGKILL was absorbed by resume, not truncation
+    (docs/RESILIENCE.md)."""
+    import re
+
+    out: Dict[str, float] = {}
+    for line in metrics_text.splitlines():
+        if line.startswith("router_midstream_resumes_total{"):
+            m = re.search(r'outcome="([^"]+)"', line)
+            if m:
+                try:
+                    out[m.group(1)] = float(line.rsplit(" ", 1)[1])
+                except ValueError:
+                    continue
+        elif line.startswith("router_truncations_total "):
+            try:
+                out["truncations"] = float(line.rsplit(" ", 1)[1])
+            except ValueError:
+                continue
+    return out
+
+
 def parse_slo_attainment(metrics_text: str) -> Dict[str, float]:
     """router_slo_attainment{slo_class="..."} values from exposition text."""
     import re
@@ -573,6 +629,31 @@ def parse_slo_attainment(metrics_text: str) -> Dict[str, float]:
             except ValueError:
                 continue
     return out
+
+
+def _await_running(engine_url: str, timeout_s: float) -> bool:
+    """Poll an engine's /metrics until it reports a running request (or
+    the timeout). Used by the ``kill_engine`` fault's ``await_running``
+    param so the SIGKILL provably lands MID-STREAM — killing an idle
+    engine proves failover, not resume."""
+    import urllib.request
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(
+                f"{engine_url}/metrics", timeout=5
+            ) as resp:
+                text = resp.read().decode("utf-8", "replace")
+        except OSError:
+            time.sleep(0.1)
+            continue
+        for line in text.splitlines():
+            if line.startswith("vllm:num_requests_running") and \
+                    not line.rstrip().endswith(" 0"):
+                return True
+        time.sleep(0.05)
+    return False
 
 
 def _post_fault(engine_url: str, payload: dict) -> dict:
@@ -609,6 +690,23 @@ def make_stack_executor(stack, kv_handle=None) -> Callable:
                 stack.restart_engine, fault.engine, 300.0
             )
             return {"downtime_s": round(downtime, 3)}
+        if fault.action == "kill_engine":
+            # SIGKILL, no drain: in-flight streams die mid-byte — the
+            # router must resume them on a peer (zero-truncation bar).
+            # "await_running": <seconds> first waits until the target
+            # engine reports a running request, so the kill provably
+            # interrupts a live stream instead of an idle gap.
+            info = {}
+            wait_s = float(fault.params.get("await_running", 0) or 0)
+            if wait_s > 0:
+                info["was_serving"] = await asyncio.to_thread(
+                    _await_running, stack.engine_urls[fault.engine], wait_s
+                )
+            downtime = await asyncio.to_thread(
+                stack.kill_engine, fault.engine, 300.0
+            )
+            info["downtime_s"] = round(downtime, 3)
+            return info
         if fault.action == "restart_kv_server":
             if kv_handle is None:
                 return {"skipped": True, "reason": "no kv server in stack"}
@@ -701,4 +799,5 @@ def run_soak(args) -> dict:
         rungs=rungs, faults=fault_log, faults_scheduled=len(faults),
         autoscaler_gauges=parse_autoscaler_gauges(metrics_text),
         slo_attainment_gauge=parse_slo_attainment(metrics_text),
+        midstream_resumes=parse_midstream_resumes(metrics_text),
     )

@@ -158,6 +158,20 @@ class StackHandle:
     def engine_url(self) -> str:
         return self.engine_urls[0]
 
+    def _relaunch_engine(self, index: int, startup_timeout_s: float) -> None:
+        """Relaunch engine ``index``'s exact argv/env on the same port and
+        block until /health is 200 again."""
+        env = ({**os.environ, **self.engine_env}
+               if self.engine_env else None)
+        new = subprocess.Popen(
+            self.engine_cmds[index],
+            stdout=self.engine_log_files[index], stderr=subprocess.STDOUT,
+            env=env,
+        )
+        self.engines[index] = new
+        wait_health(f"{self.engine_urls[index]}/health", startup_timeout_s,
+                    new, f"engine {self.engine_urls[index]} (restarted)")
+
     def restart_engine(self, index: int, startup_timeout_s: float = 1800.0,
                        kill_timeout_s: float = 60.0) -> float:
         """Rolling-restart engine ``index``: SIGTERM (graceful drain — the
@@ -176,16 +190,22 @@ class StackHandle:
             except subprocess.TimeoutExpired:
                 proc.kill()
                 proc.wait(timeout=kill_timeout_s)
-        env = ({**os.environ, **self.engine_env}
-               if self.engine_env else None)
-        new = subprocess.Popen(
-            self.engine_cmds[index],
-            stdout=self.engine_log_files[index], stderr=subprocess.STDOUT,
-            env=env,
-        )
-        self.engines[index] = new
-        wait_health(f"{self.engine_urls[index]}/health", startup_timeout_s,
-                    new, f"engine {self.engine_urls[index]} (restarted)")
+        self._relaunch_engine(index, startup_timeout_s)
+        return time.monotonic() - t0
+
+    def kill_engine(self, index: int, startup_timeout_s: float = 1800.0,
+                    relaunch: bool = True) -> float:
+        """HARD-kill engine ``index``: SIGKILL, no drain — in-flight SSE
+        streams die mid-byte, exactly the fault the router's mid-stream
+        resume exists for (docs/RESILIENCE.md). Then (by default) relaunch
+        on the same port like restart_engine. Returns the downtime."""
+        proc = self.engines[index]
+        t0 = time.monotonic()
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=60)
+        if relaunch:
+            self._relaunch_engine(index, startup_timeout_s)
         return time.monotonic() - t0
 
     def terminate(self) -> None:

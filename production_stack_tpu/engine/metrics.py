@@ -91,6 +91,11 @@ class EngineMetricsCollector(Collector):
                       "Leaf-first chain evictions in the local host KV "
                       "tier (a child evicted while its parent stayed)",
                       eng._offload_stat("chain_evictions_total"))
+        yield counter("pstpu:resume_restored_tokens_total",
+                      "Prompt+resume tokens served from the prefix cache "
+                      "or KV tiers on mid-stream resume requests instead "
+                      "of recomputed (docs/RESILIENCE.md)",
+                      getattr(eng, "resume_restored_tokens_total", 0))
         # Dispatch-pipeline overlap telemetry (two-slot prefill/decode
         # overlap, engine.py:_run_loop): the overlap win is observable.
         yield counter("pstpu:decode_dispatches_total",

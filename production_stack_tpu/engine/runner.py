@@ -78,10 +78,19 @@ def _dtype(name: str):
             "float16": jnp.float16}[name]
 
 
+def resolved_seed_base(request_id: str, sampling) -> int:
+    """The uint32 seed base a request's token seeds derive from. Exposed
+    (via the API server's per-chunk resume payload) so a mid-stream resume
+    on a DIFFERENT engine process reproduces the exact seed schedule even
+    for unseeded requests — ``hash(request_id)`` is randomized per process
+    (PYTHONHASHSEED), so the resolved value must ride the wire."""
+    base = sampling.seed if sampling.seed is not None \
+        else (hash(request_id) & 0x7FFFFFFF)
+    return int(base) & 0xFFFFFFFF
+
+
 def _seed_base(seq: Sequence) -> np.uint32:
-    sp = seq.sampling
-    base = sp.seed if sp.seed is not None else (hash(seq.request_id) & 0x7FFFFFFF)
-    return np.uint32(base & 0xFFFFFFFF)
+    return np.uint32(resolved_seed_base(seq.request_id, seq.sampling))
 
 
 def _token_seed(seq: Sequence, gen_index: int) -> np.uint32:

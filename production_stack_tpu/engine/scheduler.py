@@ -121,6 +121,15 @@ class Sequence:
     # (unified) on this engine even when its role would normally refuse the
     # other phase — the degrade path when a disagg pool is down.
     disagg_fallback: bool = False
+    # --- mid-stream resume (docs/RESILIENCE.md) ---
+    # Number of output tokens PRE-SEEDED from the request's resume_tokens:
+    # they were produced (and delivered) by a previous engine before it
+    # died, so this engine rebuilds their KV through the normal
+    # preemption-recompute/restore prefill path and continues decoding at
+    # generation index resume_base. They are never re-counted in
+    # generation_tokens_total (the original engine counted them).
+    resume_base: int = 0
+    _resume_counted: bool = False
 
     @property
     def hash_seed(self) -> bytes:

@@ -382,6 +382,7 @@ def initialize_all(app: web.Application, args) -> None:
         breaker_error_rate=getattr(args, "breaker_error_rate", 0.5),
         breaker_open_duration=getattr(args, "breaker_open_duration", 10.0),
         breaker_half_open_dwell=getattr(args, "breaker_half_open_dwell", 0.0),
+        max_midstream_resumes=getattr(args, "max_midstream_resumes", 1),
         default_timeout=getattr(args, "request_timeout", 300.0),
         default_ttft_deadline=getattr(args, "ttft_deadline", 0.0),
         slo_window=getattr(args, "request_stats_window", 60.0),

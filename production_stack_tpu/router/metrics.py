@@ -94,6 +94,21 @@ router_deadline_exceeded_total = Counter(
     "Requests aborted on a deadline (kind: ttft or total)",
     ["server", "kind"],
 )
+# Mid-stream resume (docs/RESILIENCE.md): a backend died after bytes were
+# on the wire and the router spliced a KV-backed continuation from another
+# backend into the same client stream — or failed to and truncated.
+router_midstream_resumes_total = Counter(
+    "router_midstream_resumes",
+    "Mid-stream backend failures the router tried to resume on another "
+    "backend (outcome: resumed = continuation spliced, failed = no backend "
+    "could attach)",
+    ["outcome"],
+)
+router_truncations_total = Counter(
+    "router_truncations",
+    "Client streams that ended without data: [DONE] (mid-stream failure "
+    "not resumed, resume budget exhausted, or mid-stream deadline)", [],
+)
 # Autoscaling signals (docs/SOAK.md): the first-class gauges an HPA /
 # prometheus-adapter pipeline targets, so helm autoscaling wiring is a
 # values-only change. Refreshed by the router's /metrics handler from the

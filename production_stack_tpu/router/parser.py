@@ -129,6 +129,13 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "before a breaker may close (hysteresis against "
                         "open/closed flap on slow stragglers; 0 closes on "
                         "the first probe success)")
+    p.add_argument("--max-midstream-resumes", type=int, default=1,
+                   help="times one client stream may be resumed on another "
+                        "backend after a mid-stream backend failure: the "
+                        "router re-issues the request with the delivered "
+                        "token ids + sampler seed and splices the "
+                        "KV-restored continuation into the same stream "
+                        "(0 restores truncation-only semantics)")
     p.add_argument("--request-timeout", type=float, default=300.0,
                    help="default total per-request deadline in seconds "
                         "(0 disables; x-request-timeout header overrides)")
@@ -153,6 +160,8 @@ def validate_args(args: argparse.Namespace) -> None:
             )
     if getattr(args, "retry_max_attempts", 1) < 1:
         raise ValueError("--retry-max-attempts must be >= 1")
+    if getattr(args, "max_midstream_resumes", 0) < 0:
+        raise ValueError("--max-midstream-resumes must be >= 0")
     if not 0 < getattr(args, "breaker_error_rate", 0.5) <= 1:
         raise ValueError("--breaker-error-rate must be in (0, 1]")
     if args.routing_logic in ("session", "cache_aware_load_balancing") \

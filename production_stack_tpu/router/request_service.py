@@ -68,6 +68,11 @@ class _MidStreamBackendError(Exception):
     client-side write errors, which must not)."""
 
 
+class _MidStreamDeadline(Exception):
+    """Marker: the request's total deadline expired mid-stream — truncate
+    the stream, never resume (the budget is spent regardless of backend)."""
+
+
 class RoutedRequest:
     """Duck-typed view handed to RoutingInterface implementations."""
 
@@ -239,6 +244,10 @@ async def route_general_request(
                     request_id, body=body, deadline=deadline,
                     traceparent=span.traceparent if span else None,
                     extra_headers=extra_headers,
+                    # Mid-stream resume (docs/RESILIENCE.md): the relay can
+                    # re-route an interrupted stream's continuation through
+                    # the same candidate pool / routing policy.
+                    endpoints=endpoints, tried=tried,
                 )
         except DeadlineExceeded as e:
             metrics.router_deadline_exceeded_total.labels(
@@ -288,6 +297,172 @@ async def route_general_request(
     )
 
 
+def _resume_eligible(body, endpoint: str) -> bool:
+    """Only single-choice streaming generations are resumed mid-stream;
+    anything else keeps PR-1 truncation-only semantics. Mirrors
+    _disagg_eligible's single-stream constraints, plus: no logprobs (a
+    resumed stream cannot retroactively carry the delivered region's
+    deferred logprob entries on its finish chunk)."""
+    if not isinstance(body, dict) or not body.get("stream"):
+        return False
+    if not endpoint.endswith("/completions"):
+        return False
+    if (body.get("n") or 1) != 1 or (body.get("best_of") or 1) != 1:
+        return False
+    if body.get("tools"):
+        return False
+    lp = body.get("logprobs")
+    if lp is not None and lp is not False:
+        # Includes logprobs: 0 (valid, and non-None to the engine) — a
+        # spliced continuation cannot retroactively carry the delivered
+        # region's logprob entries.
+        return False
+    if body.get("top_logprobs"):
+        return False
+    if not endpoint.endswith("chat/completions"):
+        p = body.get("prompt")
+        if isinstance(p, list):
+            if not (p and all(type(x) is int for x in p)):
+                return False
+        elif not isinstance(p, str):
+            return False
+    return True
+
+
+async def _attach_resume_stream(
+    app, endpoint: str, body: dict, parser, tried: set, endpoints,
+    deadline: Optional[Deadline], monitor, resilience, request_id: str,
+    base_headers: dict, client_headers=None,
+):
+    """Attach a continuation backend for an interrupted SSE relay.
+
+    Builds the resume request (original body + delivered token ids + the
+    original engine's resolved sampler seed), picks a backend through the
+    normal routing policy — the prefix-aware logic scores the delivered
+    prompt+output chain, and the dead engine's blocks are likely resident
+    in the shared tier — POSTs it, and secures the first chunk. Pre-stream
+    failures of a resume attempt consume the normal retry budget (they are
+    pre-stream for the CONTINUATION; nothing of it is on the wire yet),
+    not the resume budget. Returns (url, resp, chunk_iter, first_chunk) or
+    None — the caller degrades to truncation."""
+    from production_stack_tpu.disagg.transfer import DISAGG_FALLBACK_HEADER
+
+    cfg = _resilience_config()
+    session = app["client_session"]
+    resume_body = dict(body)
+    resume_body["resume_tokens"] = list(parser.delivered)
+    resume_body["resume_seed"] = parser.seed
+    payload = json.dumps(resume_body).encode()
+    # Drop the dead hop's disagg-plane headers (a decode-hop's transfer key
+    # is already consumed — carrying it would 503 every resume attempt) and
+    # mark the continuation fallback traffic: it must be servable
+    # end-to-end on ANY role (unified engines ignore the flag).
+    from production_stack_tpu.disagg.transfer import RESUME_HEADER
+
+    headers = {
+        name: val for name, val in base_headers.items()
+        if not name.lower().startswith("x-pstpu-")
+    }
+    headers[DISAGG_FALLBACK_HEADER] = "1"
+    headers[RESUME_HEADER] = "1"
+    # The routing policy sees the CLIENT's headers (session keys,
+    # affinity hints), not the synthetic backend header set — a
+    # session-routed continuation should land on the session's warm peer.
+    routed = RoutedRequest(
+        client_headers if client_headers is not None else base_headers,
+        resume_body,
+    )
+    attempt = 0
+    while attempt < max(1, cfg.retry_max_attempts):
+        attempt += 1
+        rem = deadline.remaining_total() if deadline is not None else None
+        if rem is not None and rem <= 0:
+            return None
+        url = _next_backend(endpoints, tried, resilience, routed)
+        if url is None:
+            return None
+        tried.add(url)
+        if resilience is not None:
+            resilience.on_dispatch(url)
+        # Same x-request-id, new backend: the dead backend's monitor entry
+        # was closed by the relay; the hop opens a fresh one so the
+        # QPS/latency planes stay consistent across the splice.
+        monitor.on_new_request(url, request_id, time.time())
+        resp = None
+        try:
+            post = session.post(
+                f"{url}{endpoint}", data=payload, headers=headers
+            )
+            resp = await (
+                asyncio.wait_for(post, rem) if rem is not None else post
+            )
+            ctype = resp.headers.get("Content-Type", "")
+            if resp.status in RETRYABLE_STATUSES:
+                status = resp.status
+                resp.close()
+                raise PreStreamFailure(
+                    url, f"resume attempt returned {status}", status=status
+                )
+            if resp.status != 200 or \
+                    not ctype.startswith("text/event-stream"):
+                # Deterministic reject (4xx / wrong response shape): every
+                # backend would answer the same, and marking healthy peers'
+                # breakers for correctly refusing a bad request would push
+                # their circuits open — give up on resume instead.
+                status = resp.status
+                resp.close()
+                monitor.on_request_complete(url, request_id, time.time())
+                logger.warning(
+                    "Resume attempt for %s at %s rejected with %s; "
+                    "not retrying", request_id, url, status,
+                )
+                return None
+            chunk_iter = resp.content.iter_any()
+            rem = deadline.remaining_total() if deadline is not None else None
+            try:
+                get_first = chunk_iter.__anext__()
+                first = await (
+                    asyncio.wait_for(get_first, rem)
+                    if rem is not None else get_first
+                )
+            except StopAsyncIteration:
+                # Empty-body stream: the relay's EOF handling treats it as
+                # another mid-stream failure (budget permitting).
+                first = None
+            return url, resp, chunk_iter, first
+        except (PreStreamFailure, asyncio.TimeoutError,
+                *_CONNECT_ERRORS) as e:
+            if resp is not None and not resp.closed:
+                # e.g. first-chunk timeout after a successful POST: the
+                # engine must not keep generating into a stranded socket.
+                resp.close()
+            monitor.on_request_complete(url, request_id, time.time())
+            if resilience is not None:
+                resilience.record_failure(url)
+            logger.warning("Resume attempt for %s at %s failed: %s",
+                           request_id, url, e)
+            if attempt < max(1, cfg.retry_max_attempts):
+                # Same capped-jittered pacing as the pre-stream retry loop:
+                # hammering the surviving pool the instant a peer died is
+                # how one failure becomes two.
+                delay = backoff_delay(attempt, cfg)
+                rem = deadline.remaining_total() \
+                    if deadline is not None else None
+                if rem is not None and rem <= delay:
+                    return None
+                await asyncio.sleep(delay)
+        except BaseException:
+            # CancelledError (client gone mid-attach) / session closed:
+            # neither the just-opened monitor entry nor an already-attached
+            # response may leak — there is no expiry, and a stuck in-flight
+            # count skews routing and autoscaling signals forever.
+            if resp is not None and not resp.closed:
+                resp.close()
+            monitor.on_request_complete(url, request_id, time.time())
+            raise
+    return None
+
+
 async def proxy_request(
     request: web.Request,
     backend_url: str,
@@ -298,12 +473,22 @@ async def proxy_request(
     traceparent: Optional[str] = None,
     deadline: Optional[Deadline] = None,
     extra_headers: Optional[dict] = None,
+    endpoints=None,
+    tried: Optional[set] = None,
 ) -> web.StreamResponse:
     """Stream the backend response through to the client.
 
     Raises PreStreamFailure (retryable) or DeadlineExceeded while nothing
-    has been sent to the client; once bytes are on the wire failures
-    truncate the stream and mark the backend instead.
+    has been sent to the client. Once bytes are on the wire:
+
+      * non-streaming responses were fully BUFFERED router-side first, so
+        a mid-body backend death is still a retryable pre-stream failure;
+      * streaming (SSE) responses relay complete events through an
+        incremental parser; a mid-stream backend failure is resumed on
+        another backend (``endpoints``/``tried`` from the routing loop, up
+        to max_midstream_resumes) by re-issuing the request with the
+        delivered token ids + sampler seed — degrading to PR-1
+        truncation-only semantics when resume is impossible.
     """
     app = request.app
     session = app["client_session"]
@@ -324,6 +509,13 @@ async def proxy_request(
         headers["traceparent"] = traceparent
     if extra_headers:
         headers.update(extra_headers)
+    if isinstance(body, dict) and body.get("stream"):
+        # Ask the engine for the per-chunk resume payload (token ids +
+        # resolved seed) so a mid-stream death is resumable. Direct API
+        # clients never send this header and get pristine OpenAI chunks.
+        from production_stack_tpu.disagg.transfer import RESUME_HEADER
+
+        headers[RESUME_HEADER] = "1"
 
     def _fail(reason: str, status: Optional[int] = None) -> PreStreamFailure:
         monitor.on_request_complete(backend_url, request_id, time.time())
@@ -408,18 +600,335 @@ async def proxy_request(
             backend_resp.close()
         raise _fail(f"unexpected pre-stream failure: {e!r}") from e
 
-    # First byte secured: record the soft SLO outcome (x-slo-class /
-    # x-slo-ttft headers; relayed 5xx bodies count as misses even when
-    # their first byte was fast).
+    # First byte secured.
     tracker = get_slo_tracker()
+    first_byte_s = (
+        time.monotonic() - deadline.start if deadline is not None else None
+    )
+    stream_requested = (
+        bool(body.get("stream")) if isinstance(body, dict) else None
+    )
+
+    if isinstance(body, dict) and not stream_requested:
+        # ------------------- buffered non-streaming relay -----------------
+        # The whole backend body is read BEFORE any byte reaches the
+        # client, so a backend dying mid-body is still a retryable
+        # pre-stream failure (the PR-1 retry/failover path) instead of a
+        # truncated JSON body the client cannot detect.
+        first_byte_wall = time.time()   # first chunk was secured just above
+        chunks = [first_chunk] if first_chunk else []
+        while True:
+            rem = deadline.remaining_total() if deadline is not None else None
+            try:
+                get_next = chunks_iter.__anext__()
+                chunk = (
+                    await asyncio.wait_for(get_next, rem)
+                    if rem is not None else await get_next
+                )
+            except StopAsyncIteration:
+                break
+            except aiohttp.ServerTimeoutError as e:
+                backend_resp.close()
+                raise _fail(f"read timed out mid-body: {e!r}") from e
+            except asyncio.TimeoutError:
+                backend_resp.close()
+                if deadline is None:
+                    raise _fail("read timed out mid-body") from None
+                raise _deadline("total") from None
+            except asyncio.CancelledError:
+                # Client gone mid-buffer: close out the stats entry (no
+                # expiry exists) before propagating the cancellation.
+                monitor.on_request_complete(backend_url, request_id,
+                                            time.time())
+                backend_resp.close()
+                raise
+            except Exception as e:  # noqa: BLE001 — mid-body backend failure
+                backend_resp.close()
+                raise _fail(f"backend failed mid-body: {e!r}") from e
+            chunks.append(chunk)
+        body_bytes = b"".join(chunks)
+        if tracker is not None and deadline is not None:
+            tracker.observe_from_headers(
+                request.headers, _resilience_config(),
+                None if backend_resp.status >= 500 else first_byte_s,
+            )
+        # TTFT plane gets the FIRST-byte instant (as the streaming relay
+        # reports it), not the end-of-buffer time — buffering must not
+        # inflate the monitor's per-backend latency stats.
+        monitor.on_request_response(backend_url, request_id, first_byte_wall)
+        monitor.on_request_complete(backend_url, request_id, time.time())
+        if resilience is not None:
+            # Relayed error responses are not breaker successes: a backend
+            # stuck returning 500s must still trip its circuit eventually.
+            if backend_resp.status >= 500:
+                resilience.record_failure(backend_url)
+            else:
+                resilience.record_success(backend_url)
+        status = backend_resp.status
+        ctype = backend_resp.headers.get("Content-Type", "application/json")
+        backend_resp.release()
+        cache = app.get("semantic_cache")
+        if cache is not None and status == 200:
+            try:
+                cache.store_response(body, body_bytes)
+            except Exception:  # noqa: BLE001 — cache store is best-effort
+                logger.exception("Semantic cache store failed")
+        callbacks = app.get("callbacks")
+        if callbacks is not None:
+            await callbacks.post_request(request, body)
+        return web.Response(
+            status=status, body=body_bytes,
+            headers={"Content-Type": ctype, "x-request-id": request_id},
+        )
+
+    # Streaming (and body-less) relays: record the soft SLO outcome at the
+    # first byte (relayed 5xx bodies count as misses even when their first
+    # byte was fast).
     if tracker is not None and deadline is not None:
         tracker.observe_from_headers(
             request.headers, _resilience_config(),
-            None if backend_resp.status >= 500
-            else time.monotonic() - deadline.start,
+            None if backend_resp.status >= 500 else first_byte_s,
         )
 
-    # From here on, bytes go to the client: failures are truncation-only.
+    if (
+        stream_requested
+        and backend_resp.status == 200
+        and backend_resp.headers.get(
+            "Content-Type", ""
+        ).startswith("text/event-stream")
+    ):
+        # -------------------- SSE relay with mid-stream resume ------------
+        from production_stack_tpu.router.sse import (
+            DONE_EVENT,
+            SseResumeParser,
+        )
+
+        cfg = _resilience_config()
+        client_resume = body.get("resume_tokens")
+        parser = SseResumeParser(
+            delivered=client_resume
+            if isinstance(client_resume, list) else None,
+        )
+        resume_ok = (
+            endpoints is not None
+            and cfg.max_midstream_resumes > 0
+            and _resume_eligible(body, endpoint)
+        )
+        response = web.StreamResponse(
+            status=backend_resp.status,
+            headers={
+                "Content-Type": backend_resp.headers.get(
+                    "Content-Type", "text/event-stream"
+                ),
+                "x-request-id": request_id,
+            },
+        )
+        cur_url, cur_resp, cur_iter = backend_url, backend_resp, chunks_iter
+        chunk = first_chunk
+        tried_pool: set = set(tried) if tried is not None else {backend_url}
+        resumes = 0
+        truncated = False
+        entry_open = True   # monitor entry for cur_url still open
+        first = True
+        try:
+            await response.prepare(request)
+            while True:       # one iteration per attached backend stream
+                try:
+                    while chunk is not None:
+                        now = time.time()
+                        if first:
+                            monitor.on_request_response(cur_url, request_id,
+                                                        now)
+                            first = False
+                        else:
+                            monitor.on_request_token(cur_url, request_id,
+                                                     now)
+                        for event in parser.feed(chunk):
+                            # The write is deadline-bounded: a client that
+                            # stops reading must not hold the request (and
+                            # its backend connection) open past
+                            # x-request-timeout.
+                            rem = deadline.remaining_total() \
+                                if deadline is not None else None
+                            if rem is not None:
+                                await asyncio.wait_for(
+                                    response.write(event), rem
+                                )
+                            else:
+                                await response.write(event)
+                        if parser.violation:
+                            # The resumed backend broke the resume protocol
+                            # (no pstpu payload / mis-aligned framing): it
+                            # may be replaying the answer from token 0.
+                            # Abort it like any mid-stream failure — the
+                            # budget decides resume-again vs truncate.
+                            raise _MidStreamBackendError(RuntimeError(
+                                "resumed backend broke the resume protocol"
+                            ))
+                        rem = deadline.remaining_total() \
+                            if deadline is not None else None
+                        try:
+                            get_next = cur_iter.__anext__()
+                            chunk = (
+                                await asyncio.wait_for(get_next, rem)
+                                if rem is not None else await get_next
+                            )
+                        except StopAsyncIteration:
+                            chunk = None
+                        except aiohttp.ServerTimeoutError as e:
+                            raise _MidStreamBackendError(e) from e
+                        except asyncio.TimeoutError:
+                            # Mid-stream deadline: truncate, NEVER resume —
+                            # the request's budget is spent no matter which
+                            # backend would serve the tail.
+                            metrics.router_deadline_exceeded_total.labels(
+                                server=cur_url, kind="total"
+                            ).inc()
+                            logger.warning(
+                                "Request %s total deadline exceeded "
+                                "mid-stream at %s", request_id, cur_url,
+                            )
+                            raise _MidStreamDeadline() from None
+                        except Exception as e:  # noqa: BLE001 — backend read
+                            raise _MidStreamBackendError(e) from e
+                    if parser.seed is not None and not parser.done \
+                            and not parser.finished and not parser.degraded:
+                        # The resume protocol guarantees a terminal [DONE];
+                        # a clean EOF without one is a backend death the
+                        # transport didn't surface as an error. A DEGRADED
+                        # (passthrough) stream stops tracking [DONE], so
+                        # its completeness is unknowable — never charge the
+                        # backend or count a truncation for it.
+                        raise _MidStreamBackendError(
+                            RuntimeError("stream ended without [DONE]")
+                        )
+                except _MidStreamDeadline:
+                    truncated = True
+                    break
+                except _MidStreamBackendError as e:
+                    if resilience is not None:
+                        resilience.record_failure(cur_url)
+                    monitor.on_request_complete(cur_url, request_id,
+                                                time.time())
+                    entry_open = False
+                    cur_resp.close()
+                    logger.warning(
+                        "Proxy to %s failed mid-stream after %d relayed "
+                        "event(s): %s", cur_url, parser.events_relayed,
+                        e.__cause__ or e,
+                    )
+                    if parser.done or parser.finished:
+                        # Semantically complete — at worst the [DONE]
+                        # sentinel died with the backend (synthesized
+                        # below). Nothing to resume.
+                        break
+                    parser.violation = False   # next attach starts clean
+                    if not (
+                        resume_ok and parser.resumable
+                        # A death before ANY token was delivered has
+                        # nothing to resume from (the engine rejects empty
+                        # resume_tokens) — degrade to truncation.
+                        and parser.delivered
+                        and resumes < cfg.max_midstream_resumes
+                        and not (deadline is not None and deadline.expired())
+                    ):
+                        truncated = True
+                        break
+                    resumes += 1
+                    try:
+                        attach = await _attach_resume_stream(
+                            app, endpoint, body, parser, tried_pool,
+                            endpoints, deadline, monitor, resilience,
+                            request_id, headers,
+                            client_headers=request.headers,
+                        )
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:  # noqa: BLE001 — must degrade, not
+                        # masquerade as a client drop: a routing-policy or
+                        # attach bug ends the stream as an ACCOUNTED
+                        # truncation, with the real error logged.
+                        logger.exception(
+                            "Resume attach for %s failed unexpectedly",
+                            request_id,
+                        )
+                        attach = None
+                    if attach is None:
+                        metrics.router_midstream_resumes_total.labels(
+                            outcome="failed").inc()
+                        truncated = True
+                        break
+                    metrics.router_midstream_resumes_total.labels(
+                        outcome="resumed").inc()
+                    logger.info(
+                        "Request %s resumed on %s at token offset %d "
+                        "(resume %d/%d)", request_id, attach[0],
+                        len(parser.delivered), resumes,
+                        cfg.max_midstream_resumes,
+                    )
+                    cur_url, cur_resp, cur_iter, chunk = attach
+                    parser.begin_strict()
+                    entry_open = True
+                    first = True
+                    continue
+                break          # clean end of stream
+            if not truncated and parser.seed is None:
+                # Foreign (non-protocol) SSE streams may legally end
+                # without a trailing blank line: forward the unterminated
+                # tail instead of swallowing it. Protocol streams end on
+                # the [DONE] boundary; truncations drop partial frames on
+                # purpose.
+                tail = parser.flush_residue()
+                if tail:
+                    await response.write(tail)
+            if not truncated and parser.finished and not parser.done:
+                await response.write(DONE_EVENT)
+                parser.done = True
+            if truncated:
+                metrics.router_truncations_total.inc()
+            if entry_open:
+                monitor.on_request_complete(cur_url, request_id, time.time())
+                entry_open = False
+                if truncated:
+                    cur_resp.close()
+                else:
+                    if resilience is not None:
+                        resilience.record_success(cur_url)
+                    cur_resp.release()
+            try:
+                await response.write_eof()
+            except (ConnectionResetError, RuntimeError):
+                pass
+            callbacks = app.get("callbacks")
+            if callbacks is not None:
+                await callbacks.post_request(request, body)
+            return response
+        except asyncio.CancelledError:
+            if entry_open:
+                monitor.on_request_complete(cur_url, request_id, time.time())
+            cur_resp.close()
+            raise
+        except Exception as e:  # noqa: BLE001 — CLIENT-side write failure
+            # The client went away (or stalled past the deadline)
+            # mid-relay: not the backend's fault — the breaker is NOT
+            # marked and the stream is NOT resumed (client drops are not
+            # backend failures; there is no reader left to splice for).
+            if entry_open:
+                monitor.on_request_complete(cur_url, request_id, time.time())
+            if isinstance(e, asyncio.TimeoutError):
+                metrics.router_deadline_exceeded_total.labels(
+                    server=cur_url, kind="total"
+                ).inc()
+                if not parser.done:
+                    # The write-side deadline cut the stream short: count
+                    # it like the read-side mid-stream deadline does.
+                    metrics.router_truncations_total.inc()
+            logger.info("Client for request %s dropped mid-stream (%s)",
+                        request_id, e)
+            cur_resp.close()
+            return response
+
+    # ---------------- raw relay (body-less / non-SSE stream bodies) -------
     response = web.StreamResponse(
         status=backend_resp.status,
         headers={
@@ -432,13 +941,6 @@ async def proxy_request(
     completed = False   # guards double on_request_complete if write_eof fails
     try:
         await response.prepare(request)
-        full_chunks = []
-        # Only non-streamed responses are cacheable; buffering SSE bodies
-        # the cache would discard anyway just burns memory.
-        cacheable = (
-            app.get("semantic_cache") is not None
-            and body is not None and not body.get("stream")
-        )
         first = True
         chunk = first_chunk
         while chunk is not None:
@@ -448,8 +950,6 @@ async def proxy_request(
                 first = False
             else:
                 monitor.on_request_token(backend_url, request_id, now)
-            if cacheable:
-                full_chunks.append(chunk)
             rem = deadline.remaining_total() if deadline is not None else None
             # The write is also deadline-bounded: a client that stops
             # reading must not hold the request (and its backend
@@ -527,12 +1027,6 @@ async def proxy_request(
             resilience.record_success(backend_url)
     backend_resp.release()
 
-    cache = app.get("semantic_cache")
-    if cache is not None and cacheable and backend_resp.status == 200:
-        try:
-            cache.store_response(body, b"".join(full_chunks))
-        except Exception:  # noqa: BLE001 — cache store is best-effort
-            logger.exception("Semantic cache store failed")
     callbacks = app.get("callbacks")
     if callbacks is not None:
         await callbacks.post_request(request, body)

@@ -59,6 +59,12 @@ class ResilienceConfig:
     # open<->closed every probe under sustained load — a dwell makes the
     # breaker demand a sustained healthy period instead.
     breaker_half_open_dwell: float = 0.0
+    # Mid-stream resume (docs/RESILIENCE.md): how many times one client
+    # stream may be resumed on another backend after a MID-STREAM backend
+    # failure (0 restores truncation-only semantics). Each resume re-issues
+    # the request with the delivered token ids + sampler seed; the target
+    # engine restores the KV and continues token-identically.
+    max_midstream_resumes: int = 1
     # Deadlines (0 disables). Header overrides are per request.
     default_timeout: float = 300.0     # total request budget (seconds)
     default_ttft_deadline: float = 0.0  # budget to the first backend byte

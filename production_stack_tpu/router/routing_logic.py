@@ -332,6 +332,21 @@ class PrefixAwareRouter(RoutingInterface):
             return None
 
     def _prompt_token_ids(self, request) -> Optional[List[int]]:
+        ids = self._base_prompt_token_ids(request)
+        body = getattr(request, "json_body", None)
+        if ids is not None and isinstance(body, dict):
+            resume = body.get("resume_tokens")
+            if isinstance(resume, list) and resume and \
+                    all(type(t) is int for t in resume):
+                # Mid-stream resume (docs/RESILIENCE.md): the delivered
+                # output extends the chain the dead engine computed, so
+                # score backends on the FULL prompt+output chain — exactly
+                # the blocks most likely resident in the shared tier or on
+                # a sibling engine.
+                ids = list(ids) + [int(t) for t in resume]
+        return ids
+
+    def _base_prompt_token_ids(self, request) -> Optional[List[int]]:
         body = getattr(request, "json_body", None)
         if not isinstance(body, dict):
             return None

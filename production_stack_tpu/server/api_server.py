@@ -23,6 +23,7 @@ from production_stack_tpu.disagg.transfer import (
     DISAGG_KEY_HEADER,
     DISAGG_ROLE_HEADER,
     ENGINE_ROLES,
+    RESUME_HEADER,
 )
 from production_stack_tpu.engine.config import EngineConfig
 from production_stack_tpu.engine.engine import ServingEngine
@@ -815,19 +816,59 @@ class APIServer:
             for c_idx in range(n)
         ]
 
+        # Mid-stream resume (docs/RESILIENCE.md): the router re-issues an
+        # interrupted request with the already-delivered output token ids
+        # plus the original engine's resolved sampler seed; this engine
+        # rebuilds their KV via the restore pipeline and continues the
+        # stream token-identically. Single-choice generations only.
+        resume_tokens = body.get("resume_tokens")
+        resume_seed = body.get("resume_seed")
+        if resume_tokens is not None:
+            if not (isinstance(resume_tokens, list) and resume_tokens
+                    and all(type(t) is int for t in resume_tokens)):
+                return _error(
+                    400, "'resume_tokens' must be a non-empty list of "
+                         "token ids",
+                )
+            vocab = self.engine.tokenizer.vocab_size
+            if any(not 0 <= t < vocab for t in resume_tokens):
+                return _error(
+                    400, f"resume token ids must be in [0, {vocab})",
+                )
+            if num_choices != 1:
+                return _error(
+                    400, "mid-stream resume requires n=1 and a single prompt"
+                )
+            if tool_ctx is not None:
+                return _error(400, "mid-stream resume does not support tools")
+            if handoff is not None:
+                return _error(
+                    400, "mid-stream resume cannot ride a disagg decode hop"
+                )
+            if len(resume_tokens) >= sampling.max_tokens:
+                return _error(
+                    400, "resume_tokens must be shorter than max_tokens "
+                         "(the stream would already have finished)",
+                )
+            if resume_seed is not None and (
+                type(resume_seed) is bool or not isinstance(resume_seed, int)
+            ):
+                return _error(400, "'resume_seed' must be an integer")
+        n_resume = len(resume_tokens) if resume_tokens else 0
+
         # Fail BEFORE streaming headers / engine submission when a prompt is
         # statically invalid (e.g. exceeds max_model_len).
         try:
             for prompt in prompts:
-                n_prompt = (
+                n_prompt = n_resume + (
                     len(prompt) if isinstance(prompt, list)
                     else len(self.engine.tokenizer.encode(prompt))
                 )
                 if n_prompt >= self.engine.config.max_model_len:
                     return _error(
                         400,
-                        f"Prompt of {n_prompt} tokens exceeds max_model_len "
-                        f"{self.engine.config.max_model_len}",
+                        f"Prompt of {n_prompt} tokens (incl. resume) exceeds "
+                        f"max_model_len {self.engine.config.max_model_len}",
                     )
         except Exception as e:  # noqa: BLE001 — engine will re-raise if real
             logger.debug("Prompt-length precheck skipped (%s); the engine "
@@ -854,6 +895,9 @@ class APIServer:
                 kw["handoff_state"] = handoff
             if fallback:
                 kw["disagg_fallback"] = True
+            if resume_tokens:
+                kw["resume_tokens"] = list(resume_tokens)
+                kw["resume_seed"] = resume_seed
             return kw
 
         if stream:
@@ -881,9 +925,39 @@ class APIServer:
                 asyncio.ensure_future(pump(idx, p, sp, rid))
                 for idx, p, sp, rid in children
             ]
-            first_sent = [False] * num_choices
-            lp_sent = [0] * num_choices
+            # On a resumed splice the client already holds the assistant
+            # role delta and the resumed tokens' text/logprobs — start the
+            # per-choice emission bookkeeping past them.
+            first_sent = [bool(resume_tokens)] * num_choices
+            lp_sent = [n_resume] * num_choices
             lp_offset = [0] * num_choices
+            # Per-chunk resume payload (single-choice streams): the output
+            # token ids this chunk delivers, their offset in the output, and
+            # the resolved sampler seed base — everything the router's
+            # splice needs to resume this stream on another engine. Gated
+            # on the router's request header so direct API clients get
+            # pristine OpenAI chunks (and the internal seed base is only
+            # exposed where it enables the splice).
+            emit_resume_meta = num_choices == 1 and bool(
+                request.headers.get(RESUME_HEADER)
+            )
+            resume_meta_seed = 0
+            if emit_resume_meta:
+                from production_stack_tpu.engine.runner import (
+                    resolved_seed_base,
+                )
+
+                # A RESUMED request samples with the relayed resume_seed
+                # (engine.generate substitutes it into sampling), so that
+                # is the base a further resume must advertise — deriving
+                # from this request's own id would break token identity on
+                # the second hop of an unseeded stream.
+                resume_meta_seed = (
+                    int(resume_seed) & 0xFFFFFFFF
+                    if resume_tokens and resume_seed is not None
+                    else resolved_seed_base(children[0][3], children[0][2])
+                )
+            tok_sent = [n_resume] * num_choices
             tool_bufs = None
             if tool_ctx is not None:
                 from production_stack_tpu.server.tool_calling import (
@@ -971,11 +1045,26 @@ class APIServer:
                         else bool(out.text_delta) or out.finished
                     )
                     if write_now:
-                        await response.write(_sse({
+                        payload = {
                             "id": request_id, "object": object_name,
                             "created": created, "model": self.model_name,
                             "choices": [choice],
-                        }))
+                        }
+                        if emit_resume_meta:
+                            # A stop-string rollback can SHRINK token_ids
+                            # below tok_sent; clamp so the payload never
+                            # claims un-produced tokens (the stream then
+                            # finishes with "stop" — no resume follows).
+                            start_tok = min(
+                                tok_sent[idx], len(out.token_ids)
+                            )
+                            payload["pstpu"] = {
+                                "toks": list(out.token_ids[start_tok:]),
+                                "off": start_tok,
+                                "seed": resume_meta_seed,
+                            }
+                            tok_sent[idx] = len(out.token_ids)
+                        await response.write(_sse(payload))
                 if finals and body.get("stream_options", {}).get(
                     "include_usage"
                 ):

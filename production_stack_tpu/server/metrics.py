@@ -26,7 +26,7 @@ def render_engine_metrics(engine: "ServingEngine", model_name: str) -> str:
                 "kv_quant_bytes_saved_total", "queue_depth",
                 "prefix_index_size", "kv_restore_saved_tokens_total",
                 "kv_shared_tier_hits_total", "kv_shared_tier_misses_total",
-                "kv_chain_evictions_total"):
+                "kv_chain_evictions_total", "resume_restored_tokens_total"):
         s.setdefault(key, 0)
     s.setdefault("disagg_role", "unified")
     s.setdefault("kv_cache_dtype", "bfloat16")
@@ -101,6 +101,15 @@ def render_engine_metrics(engine: "ServingEngine", model_name: str) -> str:
         "# TYPE pstpu:kv_chain_evictions_total counter",
         f"pstpu:kv_chain_evictions_total{label} "
         f"{s['kv_chain_evictions_total']}",
+        # Mid-stream resume (docs/RESILIENCE.md): prompt+resume tokens a
+        # resume request served from cache/tiers instead of recomputing
+        # (the collector renders the same series).
+        "# HELP pstpu:resume_restored_tokens_total Prompt+resume tokens "
+        "served from the prefix cache or KV tiers on mid-stream resume "
+        "requests instead of recomputed",
+        "# TYPE pstpu:resume_restored_tokens_total counter",
+        f"pstpu:resume_restored_tokens_total{label} "
+        f"{s['resume_restored_tokens_total']}",
         # Two-slot dispatch-pipeline telemetry (engine.py:_run_loop): the
         # prefill/decode overlap win is observable, not asserted.
         "# HELP pstpu:decode_dispatches_total Fused decode dispatches issued",

@@ -26,7 +26,18 @@ harness's chaos executor drives engines cross-process with it):
     {"action": "straggler", "itl": 0.05, "jitter": 0.02}
     {"action": "latency", "extra": 0.5, "jitter": 0.1}
     {"action": "fail_for", "seconds": 2.0, "status": 503}
+    {"action": "drop_after_chunks", "chunks": 3, "once": true}
+    {"action": "die_mid_body", "once": true}
     {"action": "heal"}
+
+Mid-stream resume protocol (docs/RESILIENCE.md): streamed chunks carry the
+real engine's ``pstpu`` payload — deterministic token ids (BASE_TOKEN + i),
+their offset, and a fixed seed — and a request body carrying
+``resume_tokens`` continues the stream at that offset, so the router's
+splice logic is testable without spawning real engines (the
+``drop_after_chunks`` fault is the mid-stream death it splices across).
+``resume_overlap`` re-emits the last N already-delivered tokens on resume
+(the router must drop them by token offset).
 """
 
 import asyncio
@@ -35,6 +46,11 @@ import random
 import time
 
 from aiohttp import web
+
+#: Deterministic fake token ids: output index i streams as BASE_TOKEN + i.
+BASE_TOKEN = 100
+#: Fixed resolved-seed-base every fake stream reports in its pstpu payload.
+FAKE_SEED = 4242
 
 
 class FakeEngine:
@@ -60,6 +76,15 @@ class FakeEngine:
         self.unavailable_status = 503
         self.refuse_connections = False  # kill the transport pre-response
         self.die_after_chunks = None     # kill the transport mid-stream
+        self.die_once = False            # auto-heal die_after_chunks on fire
+        self.die_mid_body = False        # non-stream: close mid-JSON-body
+        self.die_mid_body_once = False
+        self.resume_overlap = 0          # resume: re-emit last N tokens
+        # False simulates a pre-resume-protocol engine (mixed-version
+        # fleet): chunks carry NO pstpu payload and resume_tokens are
+        # ignored — the stream restarts from token 0. The router must
+        # detect the violation and abort instead of splicing a duplicate.
+        self.speak_resume_protocol = True
         self.extra_latency = 0.0         # hang before the first byte
         self.extra_latency_jitter = 0.0  # + uniform(0, J) per request
         self.straggler_itl = 0.0         # extra seconds per streamed chunk
@@ -84,6 +109,9 @@ class FakeEngine:
         self.unavailable_until = 0.0
         self.refuse_connections = False
         self.die_after_chunks = None
+        self.die_once = False
+        self.die_mid_body = False
+        self.die_mid_body_once = False
         self.extra_latency = 0.0
         self.extra_latency_jitter = 0.0
         self.straggler_itl = 0.0
@@ -127,6 +155,18 @@ class FakeEngine:
         elif action == "fail_for":
             self.fail_for(float(body.get("seconds", 1.0)),
                           int(body.get("status", 503)))
+        elif action == "drop_after_chunks":
+            # Mid-stream death k SSE chunks into the response — the failure
+            # class the router's resume/splice logic exists for. ``once``
+            # auto-heals after firing so the backend can serve a later
+            # resume itself.
+            self.die_after_chunks = int(body.get("chunks", 1))
+            self.die_once = bool(body.get("once", False))
+        elif action == "die_mid_body":
+            # Non-streaming death halfway through the JSON body (the
+            # buffered-relay retry class). ``once`` auto-heals after firing.
+            self.die_mid_body = True
+            self.die_mid_body_once = bool(body.get("once", False))
         else:
             return web.json_response(
                 {"error": f"unknown fault action {action!r}"}, status=400
@@ -182,6 +222,16 @@ class FakeEngine:
         self.headers_seen.append(dict(request.headers))
         n = int(body.get("max_tokens") or self.max_tokens_default)
         stream = bool(body.get("stream", False))
+        # Mid-stream resume protocol: a request carrying resume_tokens
+        # continues the deterministic token stream at that offset (like the
+        # real engine's KV-backed resume, minus the KV).
+        resume = (body.get("resume_tokens") or []) \
+            if self.speak_resume_protocol else []
+        start = len(resume)
+        if self.resume_overlap and start:
+            # Misbehaving-backend mode: re-emit the tail of the delivered
+            # region so the router's offset dedup is exercised.
+            start = max(0, start - self.resume_overlap)
         self.running += 1
         try:
             if self.extra_latency or self.extra_latency_jitter:
@@ -208,17 +258,39 @@ class FakeEngine:
                     "usage": {"prompt_tokens": 5, "completion_tokens": n,
                               "total_tokens": 5 + n},
                 }
+                raw = json.dumps(payload).encode()
+                if self.die_mid_body:
+                    # Death halfway through the JSON body: the router's
+                    # buffered non-stream relay must treat this as a
+                    # retryable pre-stream failure, never relay half a body.
+                    if self.die_mid_body_once:
+                        self.die_mid_body = False
+                        self.die_mid_body_once = False
+                    self.faults_served += 1
+                    resp = web.StreamResponse(
+                        status=200,
+                        headers={"Content-Type": "application/json",
+                                 "Content-Length": str(len(raw))},
+                    )
+                    await resp.prepare(request)
+                    await resp.write(raw[: max(1, len(raw) // 2)])
+                    request.transport.close()
+                    return resp
                 return web.json_response(payload)
 
             resp = web.StreamResponse(
                 status=200, headers={"Content-Type": "text/event-stream"}
             )
             await resp.prepare(request)
-            for i in range(n):
+            sent_this_stream = 0
+            for i in range(start, n):
                 if (self.die_after_chunks is not None
-                        and i >= self.die_after_chunks):
+                        and sent_this_stream >= self.die_after_chunks):
                     # Mid-stream death: kill the transport with the stream
-                    # half-written (the truncation-only failure class).
+                    # half-written (the class the router resumes across).
+                    if self.die_once:
+                        self.die_after_chunks = None
+                        self.die_once = False
                     self.faults_served += 1
                     request.transport.close()
                     return resp
@@ -236,7 +308,14 @@ class FakeEngine:
                         ),
                     }],
                 }
+                if self.speak_resume_protocol:
+                    # Resume payload in the real engine's shape: this
+                    # chunk's token ids, their output offset, and the
+                    # resolved sampler seed base.
+                    chunk["pstpu"] = {"toks": [BASE_TOKEN + i], "off": i,
+                                      "seed": FAKE_SEED}
                 await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+                sent_this_stream += 1
                 if self.speed:
                     await asyncio.sleep(1.0 / self.speed)
                 if self.straggler_itl or self.straggler_jitter:

@@ -257,9 +257,14 @@ async def test_ttft_deadline_router_flag_default():
 
 
 async def test_mid_stream_death_truncates_never_resends():
-    """A backend dying mid-SSE truncates the client stream (no resend, no
-    second response) and marks the backend for the breaker."""
-    engines, servers, urls, client = await _start_stack(n_engines=1)
+    """With mid-stream resume OFF (--max-midstream-resumes 0), a backend
+    dying mid-SSE truncates the client stream (no resend, no second
+    response) and marks the backend for the breaker — the original PR-1
+    truncation-only contract. The resume/splice behavior that replaces it
+    by default is covered by tests/test_resume.py."""
+    engines, servers, urls, client = await _start_stack(
+        n_engines=1, max_midstream_resumes=0,
+    )
     try:
         engines[0].die_after_chunks = 3
         resp = await client.post("/v1/completions", json={

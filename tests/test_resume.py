@@ -1,0 +1,592 @@
+"""Mid-stream failover with KV-backed decode resume (docs/RESILIENCE.md).
+
+Three layers, matching the feature's layering:
+
+  * ENGINE resume parity (in-process, tiny random-weight model): a request
+    re-issued with ``resume_tokens`` + ``resume_seed`` continues
+    token-identically to the uninterrupted run under greedy and seeded
+    sampling, stop strings are evaluated over the JOINED text (a match
+    spanning the splice still truncates correctly), and restored tokens
+    are counted.
+  * API-server resume protocol: streamed chunks carry the ``pstpu``
+    payload (token ids, offset, resolved seed) and a resume request's
+    continuation splices into the exact delivered boundary.
+  * ROUTER splice against fault-injected fake engines: overlap dedup,
+    resume-budget exhaustion -> truncation fallback, client drops NOT
+    resumed, finish-chunk salvage, buffered non-stream failover, and
+    request-monitor consistency across the hop.
+
+The slow-marked real-engine SIGKILL e2e (two subprocess engines + router,
+one hard-killed mid-stream) runs in the explicit CI "resume chaos" step.
+"""
+
+import asyncio
+import json
+import time
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from production_stack_tpu.engine import EngineConfig, SamplingParams
+from production_stack_tpu.engine.engine import ServingEngine
+from production_stack_tpu.engine.runner import resolved_seed_base
+from tests.fake_engine import BASE_TOKEN, FAKE_SEED, FakeEngine
+from tests.test_router_e2e import _start_stack, _stop_stack
+
+
+# --------------------------------------------------------------------------
+# Engine resume parity (in-process)
+# --------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def engine_loop():
+    loop = asyncio.new_event_loop()
+    cfg = EngineConfig(
+        model="tiny-llama",
+        max_model_len=256,
+        block_size=4,
+        num_kv_blocks=128,
+        max_num_seqs=8,
+        max_num_batched_tokens=32,
+        attn_impl="xla",
+    )
+    engine = ServingEngine(cfg)
+    loop.run_until_complete(engine.start())
+    yield engine, loop
+    loop.run_until_complete(engine.stop())
+    loop.close()
+
+
+async def _collect(engine, prompt, sampling, request_id, **kw):
+    text, outs = "", []
+    async for out in engine.generate(
+        prompt=prompt, sampling=sampling, request_id=request_id, **kw
+    ):
+        text += out.text_delta
+        outs.append(out)
+    return text, outs
+
+
+def _warm_prefix(engine, tokens, stops):
+    """The text the ORIGINAL stream had delivered by ``tokens`` — the same
+    deterministic reconstruction the engine's resume warmup performs."""
+    from production_stack_tpu.engine.tokenizer import IncrementalDetokenizer
+
+    pre = IncrementalDetokenizer(engine.tokenizer).step(list(tokens))
+    hold = max((len(s) for s in stops), default=1) - 1 if stops else 0
+    return pre[: max(len(pre) - hold, 0)]
+
+
+def test_resume_greedy_token_identical(engine_loop):
+    engine, loop = engine_loop
+    sp = SamplingParams(temperature=0.0, max_tokens=12, ignore_eos=True)
+    full_text, full = loop.run_until_complete(
+        _collect(engine, "hello tpu resume", sp, "rg-full"))
+    toks = full[-1].token_ids
+    assert len(toks) == 12
+    before = engine.resume_restored_tokens_total
+    res_text, res = loop.run_until_complete(_collect(
+        engine, "hello tpu resume", sp, "rg-res",
+        resume_tokens=toks[:5], resume_seed=resolved_seed_base("rg-full", sp),
+    ))
+    assert res[-1].token_ids == toks          # token-identical continuation
+    assert res[-1].finish_reason == "length"
+    # usage reflects the FULL completion, as an uninterrupted run would
+    assert res[-1].num_output_tokens == 12
+    # delivered prefix + resumed deltas == uninterrupted text, no overlap
+    assert _warm_prefix(engine, toks[:5], []) + res_text == full_text
+    # prompt+resume KV came (at least partly) from the prefix cache of the
+    # first run — the restore telemetry must see it
+    assert engine.resume_restored_tokens_total > before
+
+
+def test_resume_seeded_sampling_token_identical(engine_loop):
+    engine, loop = engine_loop
+    sp = SamplingParams(temperature=0.9, seed=777, max_tokens=10,
+                        ignore_eos=True)
+    _, full = loop.run_until_complete(
+        _collect(engine, "sampled resume prompt", sp, "rs-full"))
+    toks = full[-1].token_ids
+    _, res = loop.run_until_complete(_collect(
+        engine, "sampled resume prompt", sp, "rs-res",
+        resume_tokens=toks[:4], resume_seed=resolved_seed_base("rs-full", sp),
+    ))
+    assert res[-1].token_ids == toks
+
+
+def test_resume_unseeded_request_resumes_via_resolved_seed(engine_loop):
+    """Unseeded sampling derives its base from hash(request_id), which is
+    process-randomized — the RESOLVED base carried by resume_seed must
+    reproduce the schedule under a different request id."""
+    engine, loop = engine_loop
+    sp = SamplingParams(temperature=1.0, max_tokens=8, ignore_eos=True)
+    _, full = loop.run_until_complete(
+        _collect(engine, "unseeded resume prompt", sp, "ru-full"))
+    toks = full[-1].token_ids
+    _, res = loop.run_until_complete(_collect(
+        engine, "unseeded resume prompt", sp, "ru-DIFFERENT-ID",
+        resume_tokens=toks[:3], resume_seed=resolved_seed_base("ru-full", sp),
+    ))
+    assert res[-1].token_ids == toks
+
+
+def test_resume_stop_string_across_the_splice(engine_loop):
+    """A stop string whose match STARTS in the delivered region and
+    completes in the resumed continuation must still stop the stream with
+    the correctly truncated joined text (OpenAI semantics: stop excluded)."""
+    engine, loop = engine_loop
+    sp = SamplingParams(temperature=0.0, max_tokens=16, ignore_eos=True)
+    full_text, full = loop.run_until_complete(
+        _collect(engine, "stop splice prompt", sp, "ss-full"))
+    toks = full[-1].token_ids
+    tok = engine.tokenizer
+    pick = None
+    for k in range(4, len(toks) - 2):
+        p = tok.decode(toks[:k])
+        b = len(p)
+        if not full_text.startswith(p) or b < 2 or b + 2 > len(full_text):
+            continue
+        stop = full_text[b - 2: b + 2]
+        # First occurrence must span the splice boundary, or the reference
+        # run would have stopped before the interruption point.
+        if len(stop) == 4 and full_text.find(stop) == b - 2:
+            pick = (k, stop, b)
+            break
+    if pick is None:
+        pytest.skip("random-weight output admits no boundary-spanning stop")
+    k, stop, b = pick
+    sp_stop = SamplingParams(temperature=0.0, max_tokens=16,
+                             ignore_eos=True, stop=[stop])
+    ref_text, ref = loop.run_until_complete(
+        _collect(engine, "stop splice prompt", sp_stop, "ss-ref"))
+    assert ref[-1].finish_reason == "stop"
+    assert ref_text == full_text[: b - 2]
+    assert stop not in ref_text
+    res_text, res = loop.run_until_complete(_collect(
+        engine, "stop splice prompt", sp_stop, "ss-res",
+        resume_tokens=toks[:k],
+        resume_seed=resolved_seed_base("ss-ref", sp_stop),
+    ))
+    assert res[-1].finish_reason == "stop"
+    assert res[-1].token_ids == ref[-1].token_ids
+    joined = _warm_prefix(engine, toks[:k], [stop]) + res_text
+    assert joined == ref_text
+
+
+def test_resume_rejects_already_finished_stream(engine_loop):
+    engine, loop = engine_loop
+    sp = SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True)
+
+    async def run():
+        with pytest.raises(ValueError, match="resume_tokens"):
+            async for _ in engine.generate(
+                prompt="x", sampling=sp, request_id="rf-1",
+                resume_tokens=[1, 2, 3, 4], resume_seed=0,
+            ):
+                pass
+
+    loop.run_until_complete(run())
+
+
+# --------------------------------------------------------------------------
+# API-server resume protocol (pstpu chunk payload + HTTP resume roundtrip)
+# --------------------------------------------------------------------------
+async def test_stream_chunks_carry_resume_payload_and_roundtrip():
+    from production_stack_tpu.server.api_server import APIServer
+
+    cfg = EngineConfig(
+        model="tiny-llama", max_model_len=256, block_size=4,
+        num_kv_blocks=128, max_num_seqs=8, max_num_batched_tokens=32,
+        attn_impl="xla",
+    )
+    server = APIServer(ServingEngine(cfg))
+    client = TestClient(TestServer(server.build_app()))
+    await client.start_server()
+    try:
+        body = {"model": "tiny-llama", "prompt": "roundtrip prompt",
+                "max_tokens": 10, "temperature": 0, "ignore_eos": True,
+                "stream": True}
+        # Without the router's opt-in header, chunks stay pristine OpenAI.
+        resp = await client.post("/v1/completions", json=body)
+        assert resp.status == 200
+        plain = (await resp.content.read()).decode()
+        assert '"pstpu"' not in plain
+
+        hdr = {"x-pstpu-resume": "1"}
+        resp = await client.post("/v1/completions", json=body, headers=hdr)
+        assert resp.status == 200
+        raw = (await resp.content.read()).decode()
+        events = [ln for ln in raw.splitlines() if ln.startswith("data:")]
+        assert events[-1] == "data: [DONE]"
+        chunks = [json.loads(e[5:]) for e in events[:-1]]
+        toks, offs, seeds = [], [], set()
+        full_text = ""
+        for c in chunks:
+            assert "pstpu" in c, c       # every chunk carries resume state
+            assert c["pstpu"]["off"] == len(toks)   # contiguous offsets
+            toks += c["pstpu"]["toks"]
+            offs.append(c["pstpu"]["off"])
+            seeds.add(c["pstpu"]["seed"])
+            full_text += c["choices"][0].get("text", "")
+        assert len(toks) == 10
+        assert len(seeds) == 1
+        seed = seeds.pop()
+
+        # Resume from token 4 over HTTP: the continuation must splice at
+        # the exact delivered boundary and re-emit nothing.
+        k = 4
+        resume_body = dict(body)
+        resume_body["resume_tokens"] = toks[:k]
+        resume_body["resume_seed"] = seed
+        resp = await client.post("/v1/completions", json=resume_body,
+                                 headers=hdr)
+        assert resp.status == 200
+        raw = (await resp.content.read()).decode()
+        events = [ln for ln in raw.splitlines() if ln.startswith("data:")]
+        assert events[-1] == "data: [DONE]"
+        rchunks = [json.loads(e[5:]) for e in events[:-1]]
+        rtoks = [t for c in rchunks for t in c["pstpu"]["toks"]]
+        assert rtoks == toks[k:]         # continuation only, no overlap
+        assert all(c["pstpu"]["off"] >= k for c in rchunks)
+        rtext = "".join(c["choices"][0].get("text", "") for c in rchunks)
+        eng = server.engine
+        assert _warm_prefix(eng, toks[:k], []) + rtext == full_text
+    finally:
+        await client.close()
+
+
+# --------------------------------------------------------------------------
+# Router splice (fault-injected fake engines)
+# --------------------------------------------------------------------------
+async def _read_stream(client, body, headers=None):
+    resp = await client.post("/v1/completions", json=body,
+                             headers=headers or {})
+    assert resp.status == 200
+    raw = (await resp.content.read()).decode()
+    events = [ln for ln in raw.splitlines() if ln.startswith("data:")]
+    chunks = [json.loads(e[5:]) for e in events
+              if e != "data: [DONE]"]
+    text = "".join(c["choices"][0].get("text", "") for c in chunks)
+    toks = [t for c in chunks for t in c.get("pstpu", {}).get("toks", [])]
+    return events, chunks, text, toks
+
+
+async def _counter(client, series: str) -> float:
+    """Current value of one exposition line (prometheus counters are
+    process-global, so tests assert DELTAS, never absolutes)."""
+    text = await (await client.get("/metrics")).text()
+    for line in text.splitlines():
+        if line.startswith(series + " "):
+            return float(line.rsplit(" ", 1)[1])
+    return 0.0
+
+
+RESUMED = 'router_midstream_resumes_total{outcome="resumed"}'
+TRUNCATIONS = "router_truncations_total"
+
+
+async def _arm_victim(client, engines, **fault):
+    """Advance round-robin with a probe request so the NEXT request lands
+    on a KNOWN engine, and arm the fault attributes on that one."""
+    resp = await client.post("/v1/completions", json={
+        "model": "m1", "prompt": "probe", "max_tokens": 1,
+    })
+    assert resp.status == 200
+    await resp.read()
+    victim = next(e for e in engines if not e.requests_seen)
+    for key, val in fault.items():
+        setattr(victim, key, val)
+    return victim
+
+
+def _stream_bodies(engines):
+    return [b for e in engines for _, b in e.requests_seen
+            if b.get("stream")]
+
+
+async def test_midstream_kill_resumes_and_splices():
+    """A backend dying mid-SSE is resumed on a peer: the client sees ONE
+    contiguous stream ending in [DONE], the resume request carries the
+    delivered token ids + seed, and the request monitor closes the dead
+    backend's entry and opens the new one under the same x-request-id."""
+    from production_stack_tpu.router.stats import get_request_stats_monitor
+
+    engines, servers, urls, client = await _start_stack(n_engines=2)
+    try:
+        resumed0 = await _counter(client, RESUMED)
+        trunc0 = await _counter(client, TRUNCATIONS)
+        await _arm_victim(client, engines, die_after_chunks=3, die_once=True)
+        events, chunks, text, toks = await _read_stream(client, {
+            "model": "m1", "prompt": "x", "max_tokens": 8, "stream": True,
+        })
+        assert events[-1] == "data: [DONE]"
+        assert text == "Hello " * 8            # nothing lost, nothing doubled
+        assert toks == [BASE_TOKEN + i for i in range(8)]
+        bodies = _stream_bodies(engines)
+        assert len(bodies) == 2                # original + one resume
+        resume = [b for b in bodies if b.get("resume_tokens")]
+        assert len(resume) == 1
+        # The victim wrote 3 chunks, but an abortive close may discard its
+        # final event's bytes in flight — the router resumes from whatever
+        # PREFIX it verifiably delivered (the client stream above is whole
+        # either way).
+        rt = resume[0]["resume_tokens"]
+        assert 1 <= len(rt) <= 3
+        assert rt == [BASE_TOKEN + i for i in range(len(rt))]
+        assert resume[0]["resume_seed"] == FAKE_SEED
+        # Monitor consistency across the hop: both backends' entries are
+        # closed (nothing leaks in-flight under the shared x-request-id).
+        stats = get_request_stats_monitor().get_request_stats(time.time())
+        for url in urls:
+            if url in stats:
+                assert stats[url].in_prefill_requests == 0
+                assert stats[url].in_decoding_requests == 0
+        assert await _counter(client, RESUMED) == resumed0 + 1
+        assert await _counter(client, TRUNCATIONS) == trunc0
+    finally:
+        await _stop_stack(servers, client)
+
+
+async def test_resume_overlap_dedup_by_token_offset():
+    """A resumed backend that re-emits already-delivered tokens (overlap)
+    must have them dropped by token offset — the client text contains no
+    duplicated bytes."""
+    engines, servers, urls, client = await _start_stack(n_engines=2)
+    try:
+        for e in engines:
+            e.resume_overlap = 2     # resume re-emits the last 2 tokens
+        await _arm_victim(client, engines, die_after_chunks=4, die_once=True)
+        events, chunks, text, toks = await _read_stream(client, {
+            "model": "m1", "prompt": "x", "max_tokens": 10, "stream": True,
+        })
+        assert events[-1] == "data: [DONE]"
+        assert text == "Hello " * 10
+        assert toks == [BASE_TOKEN + i for i in range(10)]
+    finally:
+        await _stop_stack(servers, client)
+
+
+async def test_resume_budget_exhaustion_degrades_to_truncation():
+    """Every backend keeps dying: one resume is attempted (default budget
+    1), then the stream degrades to the PR-1 truncation semantics — no
+    [DONE], truncation counted."""
+    engines, servers, urls, client = await _start_stack(n_engines=2)
+    try:
+        resumed0 = await _counter(client, RESUMED)
+        trunc0 = await _counter(client, TRUNCATIONS)
+        for e in engines:
+            e.die_after_chunks = 3   # persistent: the resume dies too
+        events, chunks, text, toks = await _read_stream(client, {
+            "model": "m1", "prompt": "x", "max_tokens": 12, "stream": True,
+        })
+        assert "data: [DONE]" not in events     # visibly truncated
+        assert 0 < len(toks) < 12
+        assert toks == [BASE_TOKEN + i for i in range(len(toks))]  # no dup
+        assert await _counter(client, RESUMED) == resumed0 + 1
+        assert await _counter(client, TRUNCATIONS) == trunc0 + 1
+    finally:
+        await _stop_stack(servers, client)
+
+
+async def test_resume_onto_protocol_ignorant_backend_aborts():
+    """Mixed-version fleet: the resume lands on a backend that ignores
+    resume_tokens and restarts the answer from token 0 WITHOUT pstpu
+    payloads. The router must detect the protocol violation on the first
+    content chunk and abort (degrading to truncation) — never splice the
+    answer's beginning in again."""
+    engines, servers, urls, client = await _start_stack(n_engines=2)
+    try:
+        trunc0 = await _counter(client, TRUNCATIONS)
+        victim = await _arm_victim(client, engines,
+                                   die_after_chunks=3, die_once=True)
+        peer = next(e for e in engines if e is not victim)
+        peer.speak_resume_protocol = False
+        events, chunks, text, toks = await _read_stream(client, {
+            "model": "m1", "prompt": "x", "max_tokens": 8, "stream": True,
+        })
+        assert "data: [DONE]" not in events      # aborted, not spliced
+        # Exactly the victim's delivered prefix, no duplicated beginning.
+        assert 0 < len(text.split()) <= 3
+        assert text == "Hello " * len(text.split())
+        assert await _counter(client, TRUNCATIONS) == trunc0 + 1
+    finally:
+        await _stop_stack(servers, client)
+
+
+async def test_client_drop_is_not_resumed():
+    """A CLIENT disconnect mid-stream must never trigger a resume — there
+    is no reader left to splice for, and the backend is not at fault."""
+    engines, servers, urls, client = await _start_stack(n_engines=2)
+    try:
+        resumed0 = await _counter(client, RESUMED)
+        engines[0].speed = engines[1].speed = 30.0   # slow enough to drop
+        resp = await client.post("/v1/completions", json={
+            "model": "m1", "prompt": "x", "max_tokens": 40, "stream": True,
+        })
+        assert resp.status == 200
+        await resp.content.read(10)      # a few bytes, then walk away
+        resp.close()
+        await asyncio.sleep(0.5)         # let the router notice the drop
+        bodies = _stream_bodies(engines)
+        assert len(bodies) == 1          # the original request only
+        assert not any(b.get("resume_tokens") for b in bodies)
+        assert await _counter(client, RESUMED) == resumed0
+    finally:
+        await _stop_stack(servers, client)
+
+
+async def test_finish_chunk_salvage_synthesizes_done():
+    """Backend dies AFTER the finish chunk but before [DONE]: the stream
+    was semantically complete, so the router synthesizes the terminator
+    instead of resuming or truncating."""
+    engines, servers, urls, client = await _start_stack(n_engines=2)
+    try:
+        trunc0 = await _counter(client, TRUNCATIONS)
+        for e in engines:
+            # Dies exactly after the last content chunk (which carries
+            # finish_reason), before writing [DONE].
+            e.die_after_chunks = 5
+        events, chunks, text, toks = await _read_stream(client, {
+            "model": "m1", "prompt": "x", "max_tokens": 5, "stream": True,
+        })
+        assert events[-1] == "data: [DONE]"     # synthesized by the router
+        assert text == "Hello " * 5
+        bodies = _stream_bodies(engines)
+        assert len(bodies) == 1                 # no resume was needed
+        assert await _counter(client, TRUNCATIONS) == trunc0
+    finally:
+        await _stop_stack(servers, client)
+
+
+async def test_nonstream_midbody_failure_retries_pre_stream():
+    """Non-streaming responses buffer router-side: a backend dying halfway
+    through the JSON body is a retryable pre-stream failure — the client
+    gets a complete 200 body from a peer, never half a JSON document."""
+    engines, servers, urls, client = await _start_stack(n_engines=2)
+    try:
+        for e in engines:
+            e.die_mid_body = True
+            e.die_mid_body_once = True
+        for _ in range(2):
+            resp = await client.post("/v1/completions", json={
+                "model": "m1", "prompt": "x", "max_tokens": 3,
+            })
+            assert resp.status == 200
+            body = await resp.json()             # parses: complete body
+            assert body["choices"][0]["text"] == "Hello " * 3
+        assert sum(e.faults_served for e in engines) >= 1
+    finally:
+        await _stop_stack(servers, client)
+
+
+async def test_midstream_deadline_is_not_resumed():
+    """Total-deadline expiry mid-stream truncates WITHOUT a resume attempt
+    — the budget is spent regardless of which backend serves the tail."""
+    engines, servers, urls, client = await _start_stack(n_engines=2)
+    try:
+        resumed0 = await _counter(client, RESUMED)
+        trunc0 = await _counter(client, TRUNCATIONS)
+        engines[0].speed = engines[1].speed = 10.0
+        resp = await client.post(
+            "/v1/completions",
+            json={"model": "m1", "prompt": "x", "max_tokens": 50,
+                  "stream": True},
+            headers={"x-request-timeout": "0.8"},
+        )
+        assert resp.status == 200
+        raw = (await resp.content.read()).decode()
+        assert "data: [DONE]" not in raw
+        bodies = _stream_bodies(engines)
+        assert len(bodies) == 1
+        assert not any(b.get("resume_tokens") for b in bodies)
+        assert await _counter(client, RESUMED) == resumed0
+        assert await _counter(client, TRUNCATIONS) == trunc0 + 1
+    finally:
+        await _stop_stack(servers, client)
+
+
+# --------------------------------------------------------------------------
+# Real-engine SIGKILL e2e (explicit CI step)
+# --------------------------------------------------------------------------
+@pytest.mark.slow
+def test_real_engine_sigkill_resumes_token_identical(tmp_path):
+    """Two real tiny-llama engines behind the router; the one serving a
+    greedy stream is SIGKILLed mid-flight. The client stream must end in
+    [DONE] with output byte-identical to an uninterrupted run."""
+    import urllib.request
+
+    from benchmarks.stack import launch_stack
+
+    stack = launch_stack(
+        "tiny-llama",
+        engine_args=["--max-model-len", "256", "--block-size", "4",
+                     "--num-kv-blocks", "128", "--max-num-seqs", "8",
+                     "--max-num-batched-tokens", "32", "--attn-impl", "xla",
+                     "--no-warmup"],
+        routing_logic="roundrobin",
+        num_engines=2,
+        log_dir=str(tmp_path),
+    )
+    try:
+        body = {"model": "tiny-llama", "prompt": "sigkill resume prompt",
+                "max_tokens": 192, "temperature": 0, "ignore_eos": True,
+                "stream": True}
+
+        def _kill_serving_engine() -> bool:
+            """SIGKILL whichever engine reports a running request; retry
+            the scrape while the (long) stream is still decoding."""
+            for _ in range(40):
+                for i, url in enumerate(stack.engine_urls):
+                    try:
+                        with urllib.request.urlopen(
+                            f"{url}/metrics", timeout=10
+                        ) as m:
+                            mt = m.read().decode()
+                    except OSError:
+                        continue
+                    for ln in mt.splitlines():
+                        if ln.startswith("vllm:num_requests_running") and \
+                                not ln.rstrip().endswith(" 0"):
+                            stack.engines[i].kill()
+                            return True
+                time.sleep(0.05)
+            return False
+
+        def read_stream(kill_mid: bool):
+            req = urllib.request.Request(
+                f"{stack.router_url}/v1/completions",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            text, saw_done, killed = "", False, False
+            with urllib.request.urlopen(req, timeout=300) as resp:
+                buf = b""
+                while True:
+                    raw = resp.read(1)
+                    if not raw:
+                        break
+                    buf += raw
+                    while b"\n\n" in buf:
+                        event, buf = buf.split(b"\n\n", 1)
+                        line = event.decode().strip()
+                        if not line.startswith("data:"):
+                            continue
+                        payload = line[5:].strip()
+                        if payload == "[DONE]":
+                            saw_done = True
+                            continue
+                        chunk = json.loads(payload)
+                        text += chunk["choices"][0].get("text", "")
+                        if kill_mid and not killed and text:
+                            killed = _kill_serving_engine()
+            return text, saw_done, killed
+
+        interrupted, done, killed = read_stream(kill_mid=True)
+        assert done, "stream did not end in [DONE]"
+        assert killed, "no engine was observed serving the stream"
+        # Reference: uninterrupted run on the surviving engine (greedy is
+        # engine-independent).
+        reference, ref_done, _ = read_stream(kill_mid=False)
+        assert ref_done
+        assert interrupted == reference
+    finally:
+        stack.terminate()

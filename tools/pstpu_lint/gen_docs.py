@@ -30,6 +30,7 @@ TABLES = {
     "dispatch": "docs/PERF.md",
     "disagg": "docs/DISAGG.md",
     "resilience": "docs/RESILIENCE.md",
+    "resume": "docs/RESILIENCE.md",
     "autoscaling": "docs/SOAK.md",
     "kv-economy": "docs/KV_ECONOMY.md",
 }

@@ -135,6 +135,11 @@ REGISTRY: Tuple[Series, ...] = (
     Series("pstpu:kv_chain_evictions_total", "counter", ("model_name",),
            _BOTH_ENGINE, ("catalogue", "kv-economy"),
            "Leaf-first chain evictions in the local host KV tier"),
+    # --------------------------------------------- engine: mid-stream resume
+    Series("pstpu:resume_restored_tokens_total", "counter", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "resume"),
+           "Prompt+resume tokens served from the prefix cache or KV tiers "
+           "on mid-stream resume requests instead of recomputed"),
     Series("pstpu:disagg_role", "gauge", ("model_name", "role"),
            _BOTH_ENGINE, ("catalogue", "disagg"),
            "Engine disaggregation role (1 = active)"),
@@ -218,6 +223,19 @@ REGISTRY: Tuple[Series, ...] = (
            ("catalogue", "resilience"),
            "Deadline aborts (kind: ttft or total)",
            router_labels=("server", "kind")),
+    # ------------------------------------------- router: mid-stream resume
+    Series("router_midstream_resumes_total", "counter", (), (ROUTER,),
+           ("catalogue", "resume"),
+           "Mid-stream backend failures the router tried to resume on "
+           "another backend (outcome: resumed = continuation spliced, "
+           "failed = no backend could attach)",
+           router_labels=("outcome",)),
+    Series("router_truncations_total", "counter", (), (ROUTER,),
+           ("catalogue", "resume"),
+           "Client streams that ended without data: [DONE] (mid-stream "
+           "failure not resumed, resume budget exhausted, or mid-stream "
+           "deadline)",
+           router_labels=()),
     # ------------------------------------------------ router: autoscaling
     Series("router_queue_depth", "gauge", (), (ROUTER,),
            ("catalogue", "autoscaling"),
