@@ -36,13 +36,21 @@ PEAK_HBM_GBS = float(os.environ.get("PSTPU_PEAK_HBM_GBS", 819.0))
 
 def roofline_components(model: str, weight_dtype_bytes: float,
                         kv_cache_dtype: str, batch: int, avg_ctx: float,
-                        peak_gbs: float = None) -> dict:
+                        peak_gbs: float = None,
+                        tokens_per_target_step: float = 1.0) -> dict:
     """Aggregate decode roofline from the model's analytic byte counts —
     WEIGHT bytes (compute dtype, amortized over the batch) split from KV
     bytes (the KV-CACHE storage dtype + per-slot scale overhead, per row):
     int8 KV halves the depth-dominant term, which is why the roofline
     itself roughly doubles at long context. Pure function (unit-pinned by
-    tests/test_kv_quant.py)."""
+    tests/test_kv_quant.py).
+
+    ``tokens_per_target_step``: speculative decoding's effective emitted
+    tokens per target-model step (1 + acceptance_rate * N; docs/PERF.md
+    round 8). Each target step still streams the same weight+KV bytes,
+    but they amortize over that many emitted tokens, so the effective
+    tokens/sec ceiling scales by the factor (the draft model's own bytes
+    are deliberately excluded — the draft is sized to be negligible)."""
     from production_stack_tpu.engine.config import EngineConfig
     from production_stack_tpu.models.config import resolve_model_config
 
@@ -57,12 +65,14 @@ def roofline_components(model: str, weight_dtype_bytes: float,
         kv_cache_dtype=kv_cache_dtype
     ).kv_cache_bytes_per_token(mc)
     step_bytes_per_row = param_bytes / batch + kv_bytes_per_token * avg_ctx
+    factor = max(1.0, float(tokens_per_target_step))
     return {
         "kv_cache_dtype": kv_cache_dtype,
         "param_bytes": param_bytes,
         "kv_bytes_per_token": kv_bytes_per_token,
         "kv_bytes_per_step_per_row": kv_bytes_per_token * avg_ctx,
-        "roofline_tok_s": peak * 1e9 / step_bytes_per_row,
+        "tokens_per_target_step": factor,
+        "roofline_tok_s": peak * 1e9 / step_bytes_per_row * factor,
     }
 
 
@@ -106,6 +116,38 @@ def _scrape_prefix_counters(engine_urls) -> tuple:
             elif line.startswith("vllm:gpu_prefix_cache_queries_total"):
                 queries += float(line.rsplit(" ", 1)[1])
     return hits, queries
+
+
+def _scrape_spec_metrics(engine_urls) -> dict:
+    """Speculative-decoding telemetry summed over the engines' /metrics
+    (docs/PERF.md round 8)."""
+    import urllib.request
+
+    out = {"spec_enabled": 0.0, "spec_draft_tokens": 0.0,
+           "spec_accepted_tokens": 0.0}
+    for url in engine_urls:
+        try:
+            with urllib.request.urlopen(
+                f"{url}/metrics", timeout=10
+            ) as resp:
+                text = resp.read().decode("utf-8", "replace")
+        except OSError:
+            # Telemetry is best-effort: a scrape failure must not fail
+            # the benchmark run itself.
+            continue
+        for line in text.splitlines():
+            if line.startswith("pstpu:spec_enabled"):
+                out["spec_enabled"] = max(
+                    out["spec_enabled"], float(line.rsplit(" ", 1)[1])
+                )
+            elif line.startswith("pstpu:spec_draft_tokens_total"):
+                out["spec_draft_tokens"] += float(line.rsplit(" ", 1)[1])
+            elif line.startswith("pstpu:spec_accepted_tokens_total"):
+                out["spec_accepted_tokens"] += float(line.rsplit(" ", 1)[1])
+    out["spec_acceptance_rate"] = round(
+        out["spec_accepted_tokens"] / out["spec_draft_tokens"], 4
+    ) if out["spec_draft_tokens"] else 0.0
+    return out
 
 
 def _scrape_handoff_metrics(url: str) -> dict:
@@ -171,6 +213,16 @@ def bench_stack(args) -> dict:
             *(["--decode-loop", args.decode_loop]
               if args.decode_loop else []),
             *(["--no-overlap-dispatch"] if args.no_overlap else []),
+            # getattr: test harnesses build partial Namespaces.
+            *(["--speculative-num-tokens",
+               str(getattr(args, "speculative_num_tokens", 0)),
+               "--speculative-model",
+               getattr(args, "speculative_model", None) or ""]
+              if getattr(args, "speculative_num_tokens", 0) else []),
+            *(["--speculative-draft-window",
+               str(getattr(args, "speculative_draft_window", None))]
+              if getattr(args, "speculative_draft_window", None) is not None
+              else []),
         ],
         routing_logic=args.routing_logic,
         router_args=router_args,
@@ -202,6 +254,7 @@ def bench_stack(args) -> dict:
         h0, q0 = _scrape_prefix_counters(stack.engine_urls)
         records = asyncio.run(run_workload(cfg))
         h1, q1 = _scrape_prefix_counters(stack.engine_urls)
+        spec = _scrape_spec_metrics(stack.engine_urls)
     finally:
         stack.terminate()
         if kv_proc is not None and kv_proc.poll() is None:
@@ -225,6 +278,7 @@ def bench_stack(args) -> dict:
         "summary": summary,
         "avg_prompt_tokens": avg_prompt,
         "kv_hit_rate": round((h1 - h0) / max(1.0, q1 - q0), 4),
+        "spec": spec,
     }
 
 
@@ -433,6 +487,11 @@ def bench_engine(args) -> dict:
         kv_cache_dtype=args.kv_cache_dtype,
         **({"decode_loop": args.decode_loop} if args.decode_loop else {}),
         overlap_dispatch=not args.no_overlap,
+        speculative_num_tokens=getattr(args, "speculative_num_tokens", 0),
+        speculative_model=getattr(args, "speculative_model", None),
+        **({"speculative_draft_window": args.speculative_draft_window}
+           if getattr(args, "speculative_draft_window", None) is not None
+           else {}),
     )
     engine = ServingEngine(cfg)
 
@@ -447,12 +506,22 @@ def bench_engine(args) -> dict:
             await engine.stop()
 
     res = asyncio.run(run())
+    st = engine.stats()
+    drafts = st.get("spec_draft_tokens_total", 0)
     return {
         "metric": f"engine_output_throughput_{args.model}_1chip",
         "value": round(res["output_tok_s"], 2),
         "summary": res,
         "avg_prompt_tokens": res["avg_prompt_tokens"],
         "kv_hit_rate": res["kv_hit_rate"],
+        "spec": {
+            "spec_enabled": st.get("spec_enabled", 0),
+            "spec_draft_tokens": drafts,
+            "spec_accepted_tokens": st.get("spec_accepted_tokens_total", 0),
+            "spec_acceptance_rate": round(
+                st.get("spec_acceptance_rate", 0.0), 4
+            ),
+        },
     }
 
 
@@ -509,6 +578,20 @@ def main():
     ap.add_argument("--no-overlap", action="store_true",
                     help="A/B fallback: disable the two-slot prefill/"
                          "decode dispatch overlap")
+    ap.add_argument("--speculative-num-tokens", type=int, default=0,
+                    help="speculative decoding: draft-ahead tokens per "
+                         "target step for the engines AND the roofline's "
+                         "effective-tokens factor (docs/PERF.md round 8; "
+                         "requires --speculative-model)")
+    ap.add_argument("--speculative-model", default=None,
+                    help="draft model for --speculative-num-tokens (must "
+                         "share the target's vocab; the target model name "
+                         "itself gives the self-draft parity shape)")
+    ap.add_argument("--speculative-draft-window", type=int, default=None,
+                    help="engine --speculative-draft-window passthrough "
+                         "(0 = full draft context — the BENCH_r08 "
+                         "self-draft evidence shape; default: engine "
+                         "tuned value)")
     ap.add_argument("--disagg", action="store_true",
                     help="prefill/decode disaggregation smoke: 1-prefill + "
                          "1-decode stack over a shared kv_offload store, "
@@ -603,9 +686,18 @@ def main():
         EngineConfig().dtype
     ]
     avg_ctx = res["avg_prompt_tokens"] + args.max_tokens / 2
+    spec = res.get("spec") or {}
+    eff_tokens = 1.0
+    if spec.get("spec_enabled"):
+        # Effective emitted tokens per target-model step: every cycle
+        # emits the accepted drafts plus the target's own sample.
+        eff_tokens = 1.0 + (
+            spec.get("spec_acceptance_rate", 0.0)
+            * args.speculative_num_tokens
+        )
     comp = roofline_components(
         args.model, dtype_bytes, args.kv_cache_dtype, max(1, args.users),
-        avg_ctx,
+        avg_ctx, tokens_per_target_step=eff_tokens,
     )
     roofline = comp["roofline_tok_s"]
     out = {
@@ -631,6 +723,15 @@ def main():
         "kv_hit_rate": res.get("kv_hit_rate"),
         "history_tokens_per_user": args.history_tokens,
         "backend": backend,
+        # Speculative decoding (docs/PERF.md round 8): acceptance-rate
+        # telemetry + the effective-tokens factor the roofline above used.
+        "spec_enabled": int(bool(spec.get("spec_enabled", 0))),
+        "speculative_num_tokens": args.speculative_num_tokens,
+        "speculative_model": args.speculative_model,
+        "spec_draft_tokens": int(spec.get("spec_draft_tokens", 0)),
+        "spec_accepted_tokens": int(spec.get("spec_accepted_tokens", 0)),
+        "spec_acceptance_rate": spec.get("spec_acceptance_rate", 0.0),
+        "effective_tokens_per_target_step": round(eff_tokens, 4),
     }
     if args.mode == "stack":
         out.update({

@@ -144,6 +144,30 @@ class EngineConfig:
     )
     # --- LoRA (vLLM --lora-modules convention: name -> PEFT checkpoint dir)
     lora_modules: Dict[str, str] = field(default_factory=dict)
+    # --- speculative decoding (docs/PERF.md round 8) ---
+    # Draft-ahead tokens per target step inside the fused decode scan:
+    # each scan cycle runs the DRAFT model N+1 autoregressive steps, scores
+    # all N+1 positions with ONE batched target forward, and accepts the
+    # longest prefix of draft proposals that match the target's own
+    # (seeded) samples — so spec-on output is TOKEN-IDENTICAL to spec-off
+    # for greedy and seeded sampling, and the target model reads its
+    # weights once per up-to-(N+1) emitted tokens instead of once per
+    # token. 0 disables (the default serving path compiles no draft code).
+    speculative_num_tokens: int = 0
+    # Draft model (name or HF dir) — must share the target's vocabulary
+    # (validated at config construction: a mismatched draft is a clean
+    # startup error, never a mid-scan shape crash). The draft's KV lives in
+    # a small per-sequence ring in the COMPUTE dtype (bf16 on TPU), never
+    # in the paged pool.
+    speculative_model: Optional[str] = None
+    # Draft KV ring length in tokens (per sequence). 0 = max_model_len
+    # (full draft context — highest acceptance, but draft-KV memory is
+    # ring * (max_num_seqs + max_prefill_seqs) * draft KV bytes/token and
+    # is allocated OUTSIDE the paged pool's HBM budget); the bounded
+    # default keeps spec-on startup safe at long context, at the cost of
+    # the draft forgetting distant context (acceptance-only effect,
+    # never correctness).
+    speculative_draft_window: int = 1024
     # --- weights ---
     load_format: str = "auto"               # "auto" | "safetensors" | "dummy"
     seed: int = 0
@@ -158,6 +182,68 @@ class EngineConfig:
     )
     # --- serving ---
     served_model_name: Optional[str] = None
+
+    def __post_init__(self):
+        # Speculative decoding is validated at CONFIG PARSE TIME so a
+        # mis-paired draft is a clean startup error, not a mid-scan shape
+        # crash (docs/PERF.md round 8).
+        if self.speculative_num_tokens:
+            self.resolved_draft_config()
+
+    @property
+    def speculative_enabled(self) -> bool:
+        return self.speculative_num_tokens > 0
+
+    def resolved_draft_config(self):
+        """Resolve + validate the speculative draft model config against
+        this engine's target model. Raises ValueError on every
+        incompatibility the fused draft/verify scan cannot serve."""
+        from production_stack_tpu.models.config import resolve_model_config
+
+        n = self.speculative_num_tokens
+        if n < 0 or n > 16:
+            raise ValueError(
+                f"--speculative-num-tokens must be in [0, 16], got {n}"
+            )
+        if not self.speculative_model:
+            raise ValueError(
+                "--speculative-num-tokens > 0 requires --speculative-model "
+                "(the draft; e.g. facebook/opt-125m, or the target model "
+                "itself for a self-draft parity configuration)"
+            )
+        if self.kv_cache_quantized:
+            raise ValueError(
+                "speculative decoding requires --kv-cache-dtype bfloat16: "
+                "the batched verify step attends the in-chunk draft KV "
+                "unquantized, so int8 pools would break the spec-on == "
+                "spec-off token-identity bar (draft KV is always kept in "
+                "the compute dtype)"
+            )
+        if self.tensor_parallel_size > 1 or self.sequence_parallel_size > 1:
+            raise ValueError(
+                "speculative decoding currently requires "
+                "tensor_parallel_size == sequence_parallel_size == 1 "
+                "(the draft ring and verify chunk are not mesh-sharded yet)"
+            )
+        target = resolve_model_config(self.model)
+        draft = resolve_model_config(self.speculative_model)
+        if draft.vocab_size != target.vocab_size:
+            raise ValueError(
+                f"speculative draft {self.speculative_model!r} is tokenizer-"
+                f"incompatible with target {self.model!r}: draft vocab "
+                f"{draft.vocab_size} != target vocab {target.vocab_size} "
+                f"(draft proposals are accepted by token id, so the two "
+                f"models must share one tokenizer/vocabulary)"
+            )
+        return draft
+
+    @property
+    def speculative_ring_len(self) -> int:
+        """Draft KV ring length in tokens (0 = track the full context)."""
+        w = self.speculative_draft_window
+        if w <= 0:
+            return self.max_model_len
+        return min(w, self.max_model_len)
 
     def resolved_attn_impl(self, model_config) -> str:
         """Resolve the decode attention implementation for ``model_config``
@@ -182,7 +268,14 @@ class EngineConfig:
             and tp_ok
         )
         v = self.attn_impl
-        if v in ("xla", "window"):
+        if self.speculative_enabled and v in ("pallas", "paged"):
+            raise ValueError(
+                "speculative decoding requires the window attention path "
+                "(the Pallas flash-decode kernel serves single-token "
+                "queries; the batched verify step is a multi-token chunk) "
+                "— drop attn_impl=paged or --speculative-num-tokens"
+            )
+        if v in ("xla", "window") or self.speculative_enabled:
             return "window"
         if v in ("pallas", "paged"):
             if not supported:

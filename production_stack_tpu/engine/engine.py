@@ -530,6 +530,14 @@ class ServingEngine:
         # the host round-trip.
         depth = max(1, min(2, cfg.pipeline_depth)) if cfg.async_pipeline \
             else 1
+        if cfg.speculative_num_tokens:
+            # Speculative dispatches emit a VARIABLE token count, so the
+            # scheduler cannot advance state speculatively past an
+            # unfetched dispatch (positions/block tables would assume the
+            # full budget). Strict issue-fetch-apply ordering; the fused
+            # draft/verify scan amortizes the round-trip over up to
+            # K*(N+1) tokens instead (docs/PERF.md round 8).
+            depth = 1
         overlap = cfg.overlap_dispatch and depth >= 2
         in_flight: deque = deque()  # (batch, step_id, DispatchHandle) FIFO
 
@@ -830,6 +838,9 @@ class ServingEngine:
             )
         if seq.status.is_finished:
             self._ttft_recorded.discard(seq.request_id)
+            # A finished sequence's speculative draft-ring slot goes back
+            # to the free list (idempotent; no-op when spec is off).
+            self.runner.release_spec_slot(seq.request_id)
             if seq.status is not SequenceStatus.FINISHED_ABORTED:
                 self.histograms.e2e.observe(
                     time.monotonic() - seq.arrival_time
@@ -981,6 +992,15 @@ class ServingEngine:
             # a resume request served from cache/tiers instead of
             # recomputing.
             "resume_restored_tokens_total": self.resume_restored_tokens_total,
+            # Speculative decoding (docs/PERF.md round 8): draft proposals
+            # made / accepted and the lifetime acceptance rate. The bonus
+            # token each cycle emits is counted in neither (acceptance is
+            # a property of the DRAFT).
+            "spec_enabled": 1 if self.config.speculative_num_tokens else 0,
+            "spec_draft_tokens_total": self.runner.spec_draft_tokens_total,
+            "spec_accepted_tokens_total":
+                self.runner.spec_accepted_tokens_total,
+            "spec_acceptance_rate": self.runner.spec_acceptance_rate,
             "num_preemptions": self.scheduler.num_preemptions_total,
             "prompt_tokens_total": self.prompt_tokens_total,
             "generation_tokens_total": self.generation_tokens_total,

@@ -96,6 +96,26 @@ class EngineMetricsCollector(Collector):
                       "or KV tiers on mid-stream resume requests instead "
                       "of recomputed (docs/RESILIENCE.md)",
                       getattr(eng, "resume_restored_tokens_total", 0))
+        # Speculative decoding (docs/PERF.md round 8) — the text renderer
+        # exports the same four series (PL004 keeps them aligned).
+        runner = getattr(eng, "runner", None)
+        yield gauge("pstpu:spec_enabled",
+                    "Speculative decoding active "
+                    "(--speculative-num-tokens > 0)",
+                    1 if getattr(eng.config, "speculative_num_tokens", 0)
+                    else 0)
+        yield counter("pstpu:spec_draft_tokens_total",
+                      "Draft-model token proposals made inside fused "
+                      "decode dispatches",
+                      getattr(runner, "spec_draft_tokens_total", 0))
+        yield counter("pstpu:spec_accepted_tokens_total",
+                      "Draft proposals that survived target verification "
+                      "(bonus tokens not counted)",
+                      getattr(runner, "spec_accepted_tokens_total", 0))
+        yield gauge("pstpu:spec_acceptance_rate",
+                    "Lifetime fraction of draft proposals accepted by "
+                    "the target",
+                    getattr(runner, "spec_acceptance_rate", 0.0))
         # Dispatch-pipeline overlap telemetry (two-slot prefill/decode
         # overlap, engine.py:_run_loop): the overlap win is observable.
         yield counter("pstpu:decode_dispatches_total",

@@ -57,8 +57,10 @@ _POS_SENTINEL = np.int32(2**30)  # ring_pos value for not-yet-written entries
 # vector (-1 = use the host tokens0 in row 0). Chaining lets the engine
 # issue dispatch N+1 before fetching N's tokens — the blocking
 # device->host sync (~100 ms of tunnel RTT on the benched deployment, the
-# dominant serving cost) then overlaps N+1's execution.
-NUM_SCALARS = 12
+# dominant serving cost) then overlaps N+1's execution. Row 12 is the
+# sequence's slot in the speculative draft-KV ring pools (0 when
+# speculative decoding is off — the row is then never read).
+NUM_SCALARS = 13
 # Static buckets for the per-dispatch top-logprobs width: OpenAI completions
 # allows logprobs<=5, chat top_logprobs<=20; two buckets bound the compiled
 # variant count. 0 = the (default) no-logprobs variants.
@@ -201,29 +203,73 @@ class ModelRunner:
             _setup_compilation_cache(config.compilation_cache_dir)
 
         init_fn, self._forward, self._logits_fn = get_model_fns(model_config)
-        import os
-
-        if params is None and config.load_format != "dummy" \
-                and os.path.isdir(config.model):
-            # Real checkpoint: shardings from the ABSTRACT tree, then each
-            # tensor stack goes host->device already TP-placed.
-            from production_stack_tpu.models.weights import load_hf_params
-
-            abstract = jax.eval_shape(
-                lambda: init_fn(
-                    model_config, jax.random.PRNGKey(0), self.dtype
-                )
-            )
-            shardings = param_shardings(model_config, mesh, abstract)
-            params = load_hf_params(
-                model_config, config.model, self.dtype, shardings
-            )
-        elif params is None:
-            params = init_fn(
-                model_config, jax.random.PRNGKey(config.seed), self.dtype
+        if params is None:
+            params, _ = self._load_or_init_params(
+                model_config, config.model, init_fn
             )
         shardings = param_shardings(model_config, mesh, params)
         self.params = jax.tree.map(jax.device_put, params, shardings)
+
+        # --- speculative decoding (docs/PERF.md round 8) ---------------
+        # Draft model + per-sequence draft-KV rings. The draft never
+        # touches the paged pool: its KV lives in [L_d, Hkv_d, S, R, Dh_d]
+        # ring pools (S = sequence slots, R = ring tokens) in the COMPUTE
+        # dtype, gathered into batch rows per dispatch and scattered back.
+        # Allocated BEFORE the KV pool is sized: _derive_num_blocks hands
+        # hbm_utilization of FREE device memory to the paged pool, so the
+        # draft rings must already be resident or spec-on startup
+        # over-commits HBM (the rings scale with slots x ring length —
+        # bound them with --speculative-draft-window on big deployments).
+        self.spec_n = int(config.speculative_num_tokens)
+        if self.spec_n:
+            self.spec_draft_config = config.resolved_draft_config()
+            d_init, self._draft_forward, self._draft_logits = get_model_fns(
+                self.spec_draft_config
+            )
+            if config.speculative_model == config.model:
+                # Self-draft: share the target's params outright (the
+                # parity/bench configuration — identical weights make
+                # greedy acceptance ~1.0 when the ring covers the context).
+                self.spec_params = self.params
+            else:
+                self.spec_params, d_loaded = self._load_or_init_params(
+                    self.spec_draft_config, config.speculative_model,
+                    d_init,
+                )
+                if not d_loaded and config.load_format != "dummy":
+                    # Correctness is unaffected (accepted tokens are
+                    # always the TARGET's samples), so a random draft is
+                    # otherwise invisible: acceptance ~0 and speculation
+                    # becomes pure overhead.
+                    logger.warning(
+                        "Speculative draft %r resolved to RANDOM init "
+                        "weights (not a local checkpoint dir): expect "
+                        "~zero acceptance — speculation will cost "
+                        "throughput, not add it",
+                        config.speculative_model,
+                    )
+            self.spec_ring_len = config.speculative_ring_len
+            # Slot capacity: every RUNNING row plus a prefill batch of
+            # fresh prompts can hold a slot at once; LRU eviction below is
+            # the backstop, never the plan.
+            self.spec_num_slots = config.max_num_seqs + config.max_prefill_seqs
+            self._alloc_spec_pools()
+            from collections import OrderedDict
+
+            self._spec_slots: "OrderedDict[str, int]" = OrderedDict()
+            self._spec_free = list(range(self.spec_num_slots))
+            # Per-request position (exclusive) the draft ring is warmed
+            # to — the host-side ledger behind _spec_catch_up.
+            self._spec_warmed: Dict[str, int] = {}
+            # Telemetry (accumulated at fetch): proposals the draft made
+            # and how many survived verification.
+            self.spec_draft_tokens_total = 0
+            self.spec_accepted_tokens_total = 0
+        else:
+            self.spec_params = None
+            self.spec_ring_len = 1
+            self.spec_draft_tokens_total = 0
+            self.spec_accepted_tokens_total = 0
 
         self.num_kv_blocks = num_kv_blocks or config.num_kv_blocks or \
             self._derive_num_blocks()
@@ -243,7 +289,7 @@ class ModelRunner:
             self._decode_impl,
             static_argnames=("b", "mb", "num_steps", "use_cached_window",
                              "has_penalties", "logprobs_k"),
-            donate_argnums=(2, 3, 4, 5, 6, 7),
+            donate_argnums=(2, 3, 4, 5, 6, 7, 11, 12, 13),
         )
         # Persistent decode window (window impl only): consecutive decode
         # dispatches over the SAME rows reuse the gathered window and append
@@ -283,8 +329,34 @@ class ModelRunner:
             self._prefill_impl,
             static_argnames=("b", "t", "mb", "has_window", "b_max",
                              "has_penalties", "logprobs_k"),
-            donate_argnums=(2, 3, 4, 5),
+            donate_argnums=(2, 3, 4, 5, 8, 9, 10),
         )
+
+    # ----------------------------------------------------------------- weights
+    def _load_or_init_params(self, model_config, source: str, init_fn):
+        """Load a model's params from a local HF checkpoint dir, or init
+        randomly (dummy/test configs). ONE loader for the target and the
+        speculative draft so checkpoint-loading semantics can't diverge.
+        Returns (params, loaded_from_checkpoint)."""
+        import os
+
+        if self.config.load_format != "dummy" and os.path.isdir(source):
+            # Real checkpoint: shardings from the ABSTRACT tree, then each
+            # tensor stack goes host->device already TP-placed.
+            from production_stack_tpu.models.weights import load_hf_params
+
+            abstract = jax.eval_shape(
+                lambda: init_fn(
+                    model_config, jax.random.PRNGKey(0), self.dtype
+                )
+            )
+            shardings = param_shardings(model_config, self.mesh, abstract)
+            return load_hf_params(
+                model_config, source, self.dtype, shardings
+            ), True
+        return init_fn(
+            model_config, jax.random.PRNGKey(self.config.seed), self.dtype
+        ), False
 
     # ------------------------------------------------------------------ sizing
     def _alloc_kv_pools(self) -> None:
@@ -315,6 +387,186 @@ class ModelRunner:
             )
         else:
             self.kv_k_scale = self.kv_v_scale = None
+
+    # -------------------------------------------------- speculative state
+    def _alloc_spec_pools(self) -> None:
+        """Per-sequence draft-KV ring pools [L_d, Hkv_d, S, R, Dh_d] plus
+        the per-entry position plane [S, R] (sentinel = unwritten). Held in
+        the COMPUTE dtype (bf16 on TPU) — the draft is small and its KV is
+        never paged, offloaded, or quantized."""
+        dmc = self.spec_draft_config
+        s, r = self.spec_num_slots, self.spec_ring_len
+        shape = (dmc.num_layers, dmc.num_kv_heads, s, r, dmc.head_dim_)
+        ring_bytes = (
+            2 * int(np.prod(shape)) * jnp.dtype(self.dtype).itemsize
+        )
+        logger.info(
+            "Speculative draft-KV rings: %d slots x %d tokens "
+            "(%.1f MiB total, draft=%s) — bound with "
+            "--speculative-draft-window",
+            s, r, ring_bytes / (1 << 20), dmc.name,
+        )
+        self.spec_k = jnp.zeros(shape, self.dtype)
+        self.spec_v = jnp.zeros(shape, self.dtype)
+        self.spec_pos = jnp.full((s, r), _POS_SENTINEL, jnp.int32)
+
+    @functools.cached_property
+    def _reset_spec_slot_jit(self):
+        def reset(spec_pos, slot):
+            return spec_pos.at[slot].set(_POS_SENTINEL)
+        return jax.jit(reset, donate_argnums=(0,))
+
+    def spec_slot(self, request_id: str) -> int:
+        """Get-or-allocate the sequence's draft-ring slot. Fresh
+        allocations reset the slot's position plane so a previous owner's
+        ring entries can never be attended (wrong draft context is an
+        acceptance problem, not a correctness one — but a free one to
+        avoid). Falls back to LRU eviction if the free list is empty."""
+        slot = self._spec_slots.get(request_id)
+        if slot is not None:
+            self._spec_slots.move_to_end(request_id)
+            return slot
+        if self._spec_free:
+            slot = self._spec_free.pop()
+        else:
+            evicted, slot = self._spec_slots.popitem(last=False)
+            # The evicted stream's ring is gone: drop its warm ledger too,
+            # or _spec_catch_up would consider it warm forever and never
+            # re-ingest (permanent acceptance collapse for that stream).
+            self._spec_warmed.pop(evicted, None)
+            logger.warning(
+                "Draft-ring slots exhausted; evicting %s (cold draft "
+                "context lowers acceptance for that stream only)", evicted,
+            )
+        self.spec_pos = self._reset_spec_slot_jit(
+            self.spec_pos, jnp.int32(slot)
+        )
+        self._spec_slots[request_id] = slot
+        self._spec_warmed[request_id] = 0
+        return slot
+
+    def release_spec_slot(self, request_id: str) -> None:
+        """Return a finished sequence's draft-ring slot (idempotent)."""
+        if not self.spec_n:
+            return
+        self._spec_warmed.pop(request_id, None)
+        slot = self._spec_slots.pop(request_id, None)
+        if slot is not None:
+            self._spec_free.append(slot)
+
+    @functools.cached_property
+    def _spec_ingest_jit(self):
+        """Draft catch-up dispatch: replay tokens the TARGET never
+        prefilled on this engine — device prefix-cache hits, shared-tier
+        restores, disagg decode hops — through the DRAFT model so its
+        ring still holds the context (a cold ring collapses acceptance;
+        the whole long-history workload is cache hits). One row per call;
+        T is a static bucket."""
+        dmc = self.spec_draft_config
+        r_len = self.spec_ring_len
+
+        def ingest(dparams, spec_k, spec_v, spec_pos, slot, tokens,
+                   start, length, *, t: int):
+            dnl, dhkv, ddh = (dmc.num_layers, dmc.num_kv_heads,
+                              dmc.head_dim_)
+            sl = jnp.clip(slot, 0, spec_pos.shape[0] - 1)[None]
+            drk = spec_k[:, :, sl]                  # [Ld, Hd, 1, R, Dd]
+            drv = spec_v[:, :, sl]
+            drp = spec_pos[sl]                      # [1, R]
+            iota_t = jnp.arange(t, dtype=jnp.int32)
+            positions = (start + iota_t)[None, :]
+            d_max = self._spec_draft_max_pos
+            _, dk, dv = self._draft_forward(
+                dparams, dmc, tokens[None, :],
+                jnp.minimum(positions, d_max - 1), length[None],
+                None, None, None, drk, drv, drp,
+            )
+            in_chunk = iota_t[None, :] < length
+            widx = jnp.where(
+                in_chunk, positions % r_len, r_len
+            ).reshape(-1)
+            drk = drk.reshape(dnl, dhkv, r_len, ddh).at[:, :, widx].set(
+                dk.reshape(dnl, dhkv, t, ddh), mode="drop"
+            ).reshape(dnl, dhkv, 1, r_len, ddh)
+            drv = drv.reshape(dnl, dhkv, r_len, ddh).at[:, :, widx].set(
+                dv.reshape(dnl, dhkv, t, ddh), mode="drop"
+            ).reshape(dnl, dhkv, 1, r_len, ddh)
+            drp = drp.reshape(-1).at[widx].set(
+                positions.reshape(-1), mode="drop"
+            ).reshape(1, r_len)
+            return (spec_k.at[:, :, sl].set(drk),
+                    spec_v.at[:, :, sl].set(drv),
+                    spec_pos.at[sl].set(drp))
+
+        return jax.jit(ingest, static_argnames=("t",),
+                       donate_argnums=(1, 2, 3))
+
+    def _spec_catch_up(self, seq, upto: int) -> None:
+        """Ensure the sequence's draft ring covers context up to position
+        ``upto`` (exclusive): ingest the most recent min(R, upto) tokens
+        the ring has not seen. Acceptance-only machinery — never output
+        correctness — but without it a prefix-cache hit leaves the draft
+        proposing from near-zero context."""
+        rid = seq.request_id
+        warmed = self._spec_warmed.get(rid, 0)
+        if warmed >= upto:
+            return
+        r_len = self.spec_ring_len
+        # Contiguous-or-windowed: continue from what the ring holds, or —
+        # when the gap exceeds the ring — just (re)ingest the last R
+        # tokens (a full-ring rewrite, masking out every stale entry).
+        lo = max(0, upto - r_len, min(warmed, upto))
+        toks = seq.all_token_ids[lo:upto]
+        if not toks:
+            self._spec_warmed[rid] = upto
+            return
+        slot = self.spec_slot(rid)
+        t = _bucket(len(toks), 16, max(16, 1 << (r_len - 1).bit_length()))
+        padded = np.zeros((t,), np.int32)
+        padded[:len(toks)] = toks
+        self.spec_k, self.spec_v, self.spec_pos = self._spec_ingest_jit(
+            self.spec_params, self.spec_k, self.spec_v, self.spec_pos,
+            jnp.int32(slot), jnp.asarray(padded), jnp.int32(lo),
+            jnp.int32(len(toks)), t=t,
+        )
+        self._spec_warmed[rid] = upto
+
+    @property
+    def _spec_draft_max_pos(self) -> int:
+        """Position clamp for DRAFT forwards. RoPE models (llama family)
+        take any position — clamping below the target's own bound would
+        desynchronize draft and target rotary phases past the clamp and
+        collapse acceptance (measured: ~0.78 -> 0.04 at 2k context).
+        OPT-style learned position tables are bounded by the embedding
+        table size (acceptance-only saturation beyond it)."""
+        dmc = self.spec_draft_config
+        if dmc.arch == "opt":
+            return min(self.config.max_model_len,
+                       dmc.max_position_embeddings)
+        return self.config.max_model_len
+
+    def _spec_pool_args(self):
+        """(draft_params, spec_k, spec_v, spec_pos) dispatch inputs — the
+        live pools when speculative decoding is on, donation dummies
+        otherwise (never read in that mode)."""
+        if self.spec_n:
+            return self.spec_params, self.spec_k, self.spec_v, self.spec_pos
+        # Distinct arrays: the pool slots are donated, and XLA rejects the
+        # same buffer donated twice in one call.
+        return (jnp.zeros((1,), self.dtype), jnp.zeros((1,), self.dtype),
+                jnp.zeros((1,), self.dtype), jnp.zeros((1,), jnp.int32))
+
+    def _rebind_spec_pools(self, k, v, pos) -> None:
+        if self.spec_n:
+            self.spec_k, self.spec_v, self.spec_pos = k, v, pos
+
+    @property
+    def spec_acceptance_rate(self) -> float:
+        """Lifetime fraction of draft proposals that survived verification
+        (the bonus token is never counted in either side)."""
+        if not self.spec_draft_tokens_total:
+            return 0.0
+        return self.spec_accepted_tokens_total / self.spec_draft_tokens_total
 
     @property
     def kv_pool_bytes(self) -> int:
@@ -476,7 +728,8 @@ class ModelRunner:
 
     # ------------------------------------------------------------------ decode
     def _decode_impl(self, params, packed, kv_k, kv_v, kv_ks, kv_vs,
-                     win_k_in, win_v_in, counts0, prev_last, *, b: int,
+                     win_k_in, win_v_in, counts0, prev_last, dparams,
+                     spec_k, spec_v, spec_pos, *, b: int,
                      mb: int, num_steps: int, use_cached_window: bool,
                      has_penalties: bool = False, logprobs_k: int = 0):
         """One fused K-step decode dispatch.
@@ -539,6 +792,19 @@ class ModelRunner:
         lora = (adapter_idx, self.lora_stacks) if self.lora_stacks else None
         block_tables = packed[NUM_SCALARS * b:].reshape(b, mb)
         b_max = prev_last.shape[0]
+
+        if self.spec_n:
+            # Speculative draft/verify cycles replace the one-token-per-
+            # step scan entirely (docs/PERF.md round 8). Strict pipeline
+            # ordering means rows never chain start tokens from an
+            # unapplied dispatch here.
+            return self._decode_spec(
+                params, dparams, kv_k, kv_v, kv_ks, kv_vs, win_k_in,
+                win_v_in, counts0, spec_k, spec_v, spec_pos, scalars,
+                block_tables, b_max, b=b, mb=mb, num_steps=num_steps,
+                use_cached_window=use_cached_window,
+                has_penalties=has_penalties, logprobs_k=logprobs_k,
+            )
 
         # Token chaining: rows continuing from the immediately-previous
         # dispatch read their start token from its device-resident
@@ -784,9 +1050,352 @@ class ModelRunner:
                 :, :, widx.reshape(-1)
             ].set(v_flat, mode="drop").reshape(nl, hkv, b, s_tot, dh)
             return (toks_all, kv_k, kv_v, kv_ks, kv_vs, win_k, win_v,
-                    lp_chosen, lp_top, lp_ids, last_token)        # [K, b]
+                    lp_chosen, lp_top, lp_ids, last_token,
+                    *self._spec_dummy_outs(spec_k, spec_v, spec_pos))
         return (toks_all, kv_k, kv_v, kv_ks, kv_vs, win_k_in, win_v_in,
-                lp_chosen, lp_top, lp_ids, last_token)
+                lp_chosen, lp_top, lp_ids, last_token,
+                *self._spec_dummy_outs(spec_k, spec_v, spec_pos))
+
+    @staticmethod
+    def _spec_dummy_outs(spec_k, spec_v, spec_pos):
+        """Trailing outputs of the non-speculative decode variant, shaped
+        to mirror the speculative one: per-cycle emit counts + draft/accept
+        counters (all unused dummies) and the draft pools passed through."""
+        z1 = jnp.zeros((1,), jnp.int32)
+        return (jnp.zeros((1, 1), jnp.int32), z1, z1, spec_k, spec_v,
+                spec_pos)
+
+    def _decode_spec(self, params, dparams, kv_k, kv_v, kv_ks, kv_vs,
+                     win_k_in, win_v_in, counts0, spec_k, spec_v, spec_pos,
+                     scalars, block_tables, b_max, *, b: int, mb: int,
+                     num_steps: int, use_cached_window: bool,
+                     has_penalties: bool, logprobs_k: int):
+        """Speculative fused decode: draft-ahead N, verify once, accept on
+        device (docs/PERF.md round 8; Leviathan et al. 2023 shape, with
+        DETERMINISTIC acceptance so spec-on is token-identical to
+        spec-off).
+
+        Each cycle of the adaptive loop:
+          1. DRAFT — N+1 autoregressive single-token draft-model steps
+             starting from the row's last accepted token, each sampled
+             with the SAME seed the target will use at that generation
+             index (common-random-numbers: with similar distributions the
+             proposal matches the target's sample far more often than an
+             independent draw would). The extra (N+1)-th step exists only
+             to keep the draft ring's KV aligned through fully-accepted
+             cycles. Draft KV lives in the per-sequence ring rows gathered
+             for this dispatch; rejected positions roll back to sentinel.
+          2. VERIFY — ONE batched target forward over the [b, N+1] chunk
+             [t0, q_0..q_{N-1}] against window + intra-dispatch ring +
+             in-chunk causal attention: the target reads its weights once
+             for up to N+1 emitted tokens (the roofline multiplier).
+          3. ACCEPT — sampling.speculative_accept: the emitted tokens are
+             the TARGET's samples under the accepted-gen-index seed
+             schedule, so greedy and seeded output match spec-off exactly;
+             only valid entries reach the ring / pool / draft ring.
+
+        Per-row token budget (scalar row 2) counts EMITTED tokens exactly
+        as in the non-speculative scan; the loop runs until every row's
+        budget is spent (at worst ``num_steps`` cycles — one emitted token
+        per cycle at zero acceptance).
+
+        Returns the same tuple shape as the non-speculative variant, with
+        toks_all = [K, N+1, b] per-cycle verify samples, emits = [K, b]
+        per-cycle emit counts, and per-row draft/accept counters.
+        """
+        cfg = self.config
+        mc = self.model_config
+        dmc = self.spec_draft_config
+        bs = cfg.block_size
+        n_spec = self.spec_n
+        k_cyc = num_steps                   # cycle bound == token budget
+        s_ring = num_steps + n_spec + 1     # intra-dispatch target-KV ring
+        r_len = self.spec_ring_len
+        nl, hkv, dh = mc.num_layers, mc.num_kv_heads, mc.head_dim_
+        dnl, dhkv, ddh = dmc.num_layers, dmc.num_kv_heads, dmc.head_dim_
+
+        tokens0 = scalars[0]
+        pos0 = scalars[1]
+        budget = scalars[2]
+        seed_base = jax.lax.bitcast_convert_type(scalars[3], jnp.uint32)
+        gen0 = jax.lax.bitcast_convert_type(scalars[4], jnp.uint32)
+        temps = jax.lax.bitcast_convert_type(scalars[5], jnp.float32)
+        top_k = scalars[6]
+        top_p = jax.lax.bitcast_convert_type(scalars[7], jnp.float32)
+        adapter_idx = scalars[8]
+        presence = jax.lax.bitcast_convert_type(scalars[9], jnp.float32)
+        frequency = jax.lax.bitcast_convert_type(scalars[10], jnp.float32)
+        slot_idx = scalars[12]
+        lora = (adapter_idx, self.lora_stacks) if self.lora_stacks else None
+
+        if use_cached_window:
+            win_k, win_v = win_k_in, win_v_in
+        else:
+            win_k, win_v = gather_window(
+                kv_k, kv_v, block_tables, bs, None, None,
+                out_dtype=self.dtype,
+            )
+        win_len = pos0
+
+        # Draft-ring rows for this batch. GATHER clips (padding rows read
+        # some live slot harmlessly); the scatter-back uses the RAW index
+        # with mode="drop" — the host packs an out-of-range slot for
+        # padding rows, so their stale copies never clobber a live slot
+        # (duplicate-index .set order is undefined).
+        slot_c = jnp.clip(slot_idx, 0, spec_pos.shape[0] - 1)
+        drk0 = spec_k[:, :, slot_c]            # [Ld, Hd, b, R, Dd]
+        drv0 = spec_v[:, :, slot_c]
+        drp0 = spec_pos[slot_c]                # [b, R]
+
+        iota_b = jnp.arange(b, dtype=jnp.int32)
+        iota_n1 = jnp.arange(n_spec + 1, dtype=jnp.int32)
+        ones = jnp.ones((b,), jnp.int32)
+        max_len = cfg.max_model_len
+        d_max_pos = self._spec_draft_max_pos
+        full_lens = jnp.full((b,), n_spec + 1, jnp.int32)
+
+        ring_k0 = jnp.zeros((nl, hkv, b, s_ring, dh), self.dtype)
+        ring_v0 = jnp.zeros((nl, hkv, b, s_ring, dh), self.dtype)
+        ring_pos0 = jnp.full((b, s_ring), _POS_SENTINEL, jnp.int32)
+        toks_buf0 = jnp.zeros((k_cyc, n_spec + 1, b), jnp.int32)
+        emit_buf0 = jnp.zeros((k_cyc, b), jnp.int32)
+        lp_bufs0 = (
+            jnp.zeros((k_cyc, n_spec + 1, b), jnp.float32),
+            jnp.zeros((k_cyc, n_spec + 1, b, logprobs_k), jnp.float32),
+            jnp.zeros((k_cyc, n_spec + 1, b, logprobs_k), jnp.int32),
+        ) if logprobs_k else ()
+
+        from production_stack_tpu.engine.sampling import (
+            apply_penalties,
+            compute_logprobs,
+            speculative_accept,
+        )
+
+        def cycle(state):
+            (j, toks, pos, gen_off, rem, base, ring_k, ring_v, ring_pos,
+             drk, drv, drp, counts, drafts, accepted, toks_buf, emit_buf,
+             lp_bufs) = state
+            live = rem > 0
+
+            # -- 1. draft N+1 autoregressive steps ----------------------
+            def dstep(dc, i):
+                dtok, drk, drv, drp, props = dc
+                dpos = pos + i
+                dpos_c = jnp.clip(dpos, 0, d_max_pos - 1)
+                hid, dk, dv = self._draft_forward(
+                    dparams, dmc, dtok[:, None], dpos_c[:, None], ones,
+                    None, None, None, drk, drv, drp,
+                )
+                widx = jnp.where(live, iota_b * r_len + dpos % r_len,
+                                 b * r_len)
+                drk = drk.reshape(dnl, dhkv, b * r_len, ddh).at[
+                    :, :, widx
+                ].set(dk[:, :, :, 0], mode="drop").reshape(
+                    dnl, dhkv, b, r_len, ddh
+                )
+                drv = drv.reshape(dnl, dhkv, b * r_len, ddh).at[
+                    :, :, widx
+                ].set(dv[:, :, :, 0], mode="drop").reshape(
+                    dnl, dhkv, b, r_len, ddh
+                )
+                drp = drp.reshape(-1).at[widx].set(
+                    dpos, mode="drop"
+                ).reshape(b, r_len)
+                logits_d = self._draft_logits(dparams, dmc, hid[:, 0])
+                seeds_i = self._derive_seeds(
+                    seed_base, gen0 + gen_off, i.astype(jnp.uint32)
+                )
+                prop = sample_tokens(
+                    logits_d, temps, top_k, top_p, seeds_i
+                ).astype(jnp.int32)
+                props = props.at[i].set(prop)
+                return (prop, drk, drv, drp, props), None
+
+            props0 = jnp.zeros((n_spec + 1, b), jnp.int32)
+            (_, drk, drv, drp, props), _ = jax.lax.scan(
+                dstep, (toks, drk, drv, drp, props0), iota_n1
+            )
+
+            # -- 2. one batched target verify over [t0, q_0..q_{N-1}] ---
+            v_toks = jnp.concatenate(
+                [toks[:, None], props[:n_spec].T], axis=1
+            )                                               # [b, N+1]
+            v_pos = pos[:, None] + iota_n1[None, :]
+            v_pos_c = jnp.minimum(v_pos, max_len - 1)
+            hid, k_new, v_new = self._forward(
+                params, mc, v_toks, v_pos_c, full_lens,
+                win_k, win_v, win_len, ring_k, ring_v, ring_pos,
+                lora=lora,
+            )
+            logits = self._logits_fn(params, mc, hid)       # [b, N+1, V]
+            vocab = logits.shape[-1]
+            seeds = (
+                seed_base[:, None] * _SEED_MULT
+                + (gen0[:, None] + gen_off[:, None]
+                   + iota_n1[None, :].astype(jnp.uint32))
+            ).astype(jnp.uint32)                            # [b, N+1]
+            if has_penalties:
+                # Sequential over positions: position i's penalties must
+                # include this cycle's earlier samples, exactly as the
+                # one-token-per-step scan would have counted them.
+                def vstep(c, i):
+                    cnt, z = c
+                    eff = apply_penalties(
+                        logits[:, i], cnt, presence, frequency
+                    )
+                    zi = sample_tokens(
+                        eff, temps, top_k, top_p, seeds[:, i]
+                    ).astype(jnp.int32)
+                    cnt = cnt.at[iota_b, zi].add(1)
+                    z = z.at[:, i].set(zi)
+                    return (cnt, z), None
+
+                (_, z), _ = jax.lax.scan(
+                    vstep, (counts, jnp.zeros((b, n_spec + 1), jnp.int32)),
+                    iota_n1,
+                )
+            else:
+                z = sample_tokens(
+                    logits.reshape(b * (n_spec + 1), vocab),
+                    jnp.repeat(temps, n_spec + 1),
+                    jnp.repeat(top_k, n_spec + 1),
+                    jnp.repeat(top_p, n_spec + 1),
+                    seeds.reshape(-1),
+                ).reshape(b, n_spec + 1).astype(jnp.int32)
+            if logprobs_k:
+                lp = compute_logprobs(
+                    logits.reshape(b * (n_spec + 1), vocab),
+                    z.reshape(-1), logprobs_k,
+                )
+                lp_c = lp[0].reshape(b, n_spec + 1).T          # [N+1, b]
+                lp_t = lp[1].reshape(
+                    b, n_spec + 1, logprobs_k
+                ).transpose(1, 0, 2)
+                lp_i = lp[2].reshape(
+                    b, n_spec + 1, logprobs_k
+                ).transpose(1, 0, 2)
+
+            # -- 3. accept/emit -----------------------------------------
+            emit, acc = speculative_accept(props[:n_spec].T, z, rem)
+            valid_i = iota_n1[None, :] < emit[:, None]       # [b, N+1]
+            if has_penalties:
+                # Carry forward counts for EMITTED tokens only (the
+                # sequential vstep's temp counts included discarded tail
+                # positions).
+                zi_m = jnp.where(valid_i, z, vocab)          # OOB -> drop
+                counts = counts.at[
+                    jnp.broadcast_to(iota_b[:, None], (b, n_spec + 1)),
+                    zi_m,
+                ].add(1, mode="drop")
+
+            # Commit valid target KV into the intra-dispatch ring at
+            # [base, base+emit); rejected tail entries land at the drop
+            # index and are overwritten by the next cycle.
+            flat_r = jnp.where(
+                valid_i,
+                iota_b[:, None] * s_ring + base[:, None] + iota_n1[None, :],
+                b * s_ring,
+            ).reshape(-1)
+            k_chunk = k_new.reshape(nl, hkv, b * (n_spec + 1), dh)
+            v_chunk = v_new.reshape(nl, hkv, b * (n_spec + 1), dh)
+            ring_k = ring_k.reshape(nl, hkv, b * s_ring, dh).at[
+                :, :, flat_r
+            ].set(k_chunk, mode="drop").reshape(nl, hkv, b, s_ring, dh)
+            ring_v = ring_v.reshape(nl, hkv, b * s_ring, dh).at[
+                :, :, flat_r
+            ].set(v_chunk, mode="drop").reshape(nl, hkv, b, s_ring, dh)
+            ring_pos = ring_pos.reshape(-1).at[flat_r].set(
+                v_pos.reshape(-1), mode="drop"
+            ).reshape(b, s_ring)
+
+            # Draft-ring rollback: entries the draft wrote for rejected
+            # positions must never be attended (their input token was
+            # wrong); the sentinel masks them and the next cycle's draft
+            # rewrites the position with the corrected token.
+            inval = (~valid_i) & live[:, None]
+            rb_idx = jnp.where(
+                inval, iota_b[:, None] * r_len + v_pos % r_len, b * r_len
+            ).reshape(-1)
+            drp = drp.reshape(-1).at[rb_idx].set(
+                _POS_SENTINEL, mode="drop"
+            ).reshape(b, r_len)
+
+            new_tok = jnp.take_along_axis(
+                z, jnp.clip(emit - 1, 0, n_spec)[:, None], axis=1
+            )[:, 0]
+            toks = jnp.where(emit > 0, new_tok, toks)
+            pos = pos + emit
+            gen_off = gen_off + emit.astype(jnp.uint32)
+            base = base + emit
+            rem = rem - emit
+            drafts = drafts + jnp.where(live, n_spec, 0)
+            # Telemetry numerator is the PRE-budget-clip acceptance (the
+            # draft's predictive quality — speculative_accept's contract);
+            # emission may be clipped below it on a row's last tokens.
+            accepted = accepted + jnp.where(live, acc, 0)
+            toks_buf = toks_buf.at[j].set(z.T)
+            emit_buf = emit_buf.at[j].set(emit)
+            if logprobs_k:
+                lp_bufs = (
+                    lp_bufs[0].at[j].set(lp_c),
+                    lp_bufs[1].at[j].set(lp_t),
+                    lp_bufs[2].at[j].set(lp_i),
+                )
+            return (j + 1, toks, pos, gen_off, rem, base, ring_k, ring_v,
+                    ring_pos, drk, drv, drp, counts, drafts, accepted,
+                    toks_buf, emit_buf, lp_bufs)
+
+        zero_b = jnp.zeros((b,), jnp.int32)
+        state0 = (
+            jnp.int32(0), tokens0, pos0, jnp.zeros((b,), jnp.uint32),
+            budget, zero_b, ring_k0, ring_v0, ring_pos0, drk0, drv0, drp0,
+            counts0, zero_b, zero_b, toks_buf0, emit_buf0, lp_bufs0,
+        )
+        final = jax.lax.while_loop(
+            lambda st: (st[0] < k_cyc) & jnp.any(st[4] > 0),
+            cycle, state0,
+        )
+        (_, final_toks, _, _, _, _, ring_k, ring_v, ring_pos, drk, drv,
+         drp, _, drafts, accepted, toks_buf, emit_buf, lp_bufs) = final
+
+        # ONE pool scatter for the whole dispatch, slots derived from the
+        # committed ring positions (invalid entries -> reserved null
+        # block 0, never read).
+        valid_e = ring_pos < _POS_SENTINEL
+        blk = jnp.take_along_axis(
+            block_tables, jnp.clip(ring_pos // bs, 0, mb - 1), axis=1
+        )
+        flat_slots = jnp.where(
+            valid_e, blk * bs + ring_pos % bs, 0
+        ).reshape(-1)
+        k_flat = ring_k.reshape(nl, hkv, b * s_ring, dh)
+        v_flat = ring_v.reshape(nl, hkv, b * s_ring, dh)
+        kv_k = kv_k.at[:, :, flat_slots].set(k_flat)
+        kv_v = kv_v.at[:, :, flat_slots].set(v_flat)
+        # Append into the persistent window too (slot s = position s), so
+        # the next dispatch over the same rows reuses it.
+        s_tot = mb * bs
+        widx = jnp.where(
+            valid_e, iota_b[:, None] * s_tot + ring_pos, b * s_tot
+        ).reshape(-1)
+        win_k = win_k.reshape(nl, hkv, b * s_tot, dh).at[
+            :, :, widx
+        ].set(k_flat, mode="drop").reshape(nl, hkv, b, s_tot, dh)
+        win_v = win_v.reshape(nl, hkv, b * s_tot, dh).at[
+            :, :, widx
+        ].set(v_flat, mode="drop").reshape(nl, hkv, b, s_tot, dh)
+
+        spec_k = spec_k.at[:, :, slot_idx].set(drk, mode="drop")
+        spec_v = spec_v.at[:, :, slot_idx].set(drv, mode="drop")
+        spec_pos = spec_pos.at[slot_idx].set(drp, mode="drop")
+
+        last_token = jnp.zeros((b_max,), jnp.int32).at[:b].set(final_toks)
+        lp_c_buf, lp_t_buf, lp_i_buf = lp_bufs if logprobs_k else (
+            None, None, None
+        )
+        return (toks_buf, kv_k, kv_v, kv_ks, kv_vs, win_k, win_v,
+                lp_c_buf, lp_t_buf, lp_i_buf, last_token, emit_buf,
+                drafts, accepted, spec_k, spec_v, spec_pos)
 
     def _issue_decode(self, batch: ScheduledBatch) -> "DispatchHandle":
         cfg = self.config
@@ -810,8 +1419,17 @@ class ModelRunner:
             default=0,
         )
         sc[11, :] = -1
+        if self.spec_n:
+            # Padding rows get an out-of-range slot: their scatter-back
+            # drops instead of clobbering slot 0 (see _decode_spec).
+            sc[12, :] = self.spec_num_slots
         chain_entry = None  # the ONE device vector this dispatch chains from
         for i, s in enumerate(seqs):
+            if self.spec_n:
+                # Disagg decode hops / restores join decode without a
+                # local prefill; give the draft its context first.
+                self._spec_catch_up(s, s.num_computed_tokens)
+                sc[12, i] = self.spec_slot(s.request_id)
             pos = s.num_computed_tokens
             # Token chaining: a row whose last sampled token still sits in
             # an in-flight dispatch's device buffer (unapplied — the
@@ -902,25 +1520,36 @@ class ModelRunner:
             chain_entry["last"] if chain_entry is not None else self._zero_last
         )
         kv_ks, kv_vs = self._scale_pool_args()
+        dparams, sp_k, sp_v, sp_p = self._spec_pool_args()
         (toks_all, self.kv_k, self.kv_v, kv_ks2, kv_vs2, wk2, wv2, lp_c,
-         lp_t, lp_i, last_token) = self._decode(
+         lp_t, lp_i, last_token, emits, drafts_cnt, accepted_cnt, sp_k2,
+         sp_v2, sp_p2) = self._decode(
             self.params, jnp.asarray(packed), self.kv_k, self.kv_v,
             kv_ks, kv_vs, wk, wv, jnp.asarray(counts), prev_last,
+            dparams, sp_k, sp_v, sp_p,
             b=b, mb=mb, num_steps=k, use_cached_window=use_cached,
             has_penalties=has_penalties, logprobs_k=logprobs_k,
         )
         self._rebind_scale_pools(kv_ks2, kv_vs2)
+        self._rebind_spec_pools(sp_k2, sp_v2, sp_p2)
         if self.kv_quantized:
             self.kv_quant_tokens_written += sum(batch.decode_steps)
+        cache = None
         if self.attn_impl != "paged":
-            self._win_cache = {
+            cache = {
                 "ids": ids, "b": b, "mb": mb,
+                # Speculative dispatches emit a VARIABLE token count; the
+                # fetch closure below advances "end" by the actual emits
+                # (strict pipeline ordering: the next schedule pass runs
+                # only after that fetch applies).
                 "end": [
-                    seqs[i].num_computed_tokens + batch.decode_steps[i]
+                    seqs[i].num_computed_tokens
+                    + (0 if self.spec_n else batch.decode_steps[i])
                     for i in range(len(seqs))
                 ],
                 "win": (wk2, wv2),
             }
+            self._win_cache = cache
         self._push_chain({
             "last": last_token,
             "row": {s.request_id: i for i, s in enumerate(seqs)},
@@ -928,6 +1557,61 @@ class ModelRunner:
         })
         steps = list(batch.decode_steps)
         n = len(seqs)
+
+        if self.spec_n:
+            # Issue-time positions (advance_at_issue runs after this
+            # call returns, so num_computed_tokens is still pos0 here).
+            poss = [s.num_computed_tokens for s in seqs]
+
+            def fetch():
+                out = np.asarray(toks_all)          # [K, N+1, b]
+                em = np.asarray(emits)              # [K, b]
+                tokens = []
+                for i in range(n):
+                    row = []
+                    for c in range(out.shape[0]):
+                        row.extend(
+                            int(out[c, t, i]) for t in range(em[c, i])
+                        )
+                    tokens.append(row)
+                    # Ring-warm ledger: the dispatch wrote draft KV for
+                    # every emitted token.
+                    self._spec_warmed[seqs[i].request_id] = \
+                        poss[i] + len(row)
+                # Acceptance telemetry accumulates at fetch (GIL-safe
+                # int adds; the engine loop serializes runner calls).
+                self.spec_draft_tokens_total += int(
+                    np.asarray(drafts_cnt).sum()
+                )
+                self.spec_accepted_tokens_total += int(
+                    np.asarray(accepted_cnt).sum()
+                )
+                if cache is not None and self._win_cache is cache:
+                    for i in range(n):
+                        cache["end"][i] += len(tokens[i])
+                if not logprobs_k:
+                    return tokens, None
+                lpc = np.asarray(lp_c)              # [K, N+1, b]
+                lpt = np.asarray(lp_t)
+                lpi = np.asarray(lp_i)
+                lps = []
+                for i, s in enumerate(seqs):
+                    want = s.sampling.logprobs
+                    if want is None:
+                        lps.append(None)
+                        continue
+                    entries = []
+                    for c in range(out.shape[0]):
+                        for t in range(em[c, i]):
+                            top = [
+                                (int(lpi[c, t, i, r]), float(lpt[c, t, i, r]))
+                                for r in range(min(want, lpi.shape[-1]))
+                            ]
+                            entries.append((float(lpc[c, t, i]), top))
+                    lps.append(entries)
+                return tokens, lps
+
+            return DispatchHandle(fetch)
 
         def fetch():
             out = np.asarray(toks_all)  # ONE [K, B] fetch per K*B tokens
@@ -967,7 +1651,8 @@ class ModelRunner:
 
     # ----------------------------------------------------------------- prefill
     def _prefill_impl(self, params, packed, kv_k, kv_v, kv_ks, kv_vs,
-                      counts0, *, b: int, t: int, mb: int, has_window: bool,
+                      counts0, dparams, spec_k, spec_v, spec_pos, *,
+                      b: int, t: int, mb: int, has_window: bool,
                       b_max: int, has_penalties: bool = False,
                       logprobs_k: int = 0):
         """One (multi-sequence) prefill chunk dispatch.
@@ -1086,6 +1771,59 @@ class ModelRunner:
         else:
             kv_k = kv_k.at[:, :, flat_slots].set(k_flat)
             kv_v = kv_v.at[:, :, flat_slots].set(v_flat)
+        # Speculative draft warm-up (docs/PERF.md round 8): run the DRAFT
+        # model over the same chunk so its per-sequence KV ring holds the
+        # prompt context before decode starts — a cold draft ring proposes
+        # from near-zero context and acceptance collapses. Rows starting a
+        # fresh (re)prefill at chunk_start 0 reset their ring first, so a
+        # preempted/resumed sequence never attends stale entries.
+        if self.spec_n:
+            dmc = self.spec_draft_config
+            r_len = self.spec_ring_len
+            dnl, dhkv, ddh = (dmc.num_layers, dmc.num_kv_heads,
+                              dmc.head_dim_)
+            slot_idx = scalars[12]
+            # Clipped gather / raw-index drop-mode scatter: see
+            # _decode_spec (padding rows must never write slot 0).
+            slot_c = jnp.clip(slot_idx, 0, spec_pos.shape[0] - 1)
+            drk = spec_k[:, :, slot_c]
+            drv = spec_v[:, :, slot_c]
+            drp = spec_pos[slot_c]                       # [b, R]
+            drp = jnp.where(
+                (chunk_start == 0)[:, None], _POS_SENTINEL, drp
+            )
+            d_max_pos = self._spec_draft_max_pos
+            d_positions = jnp.minimum(positions, d_max_pos - 1)
+            _, dk, dv = self._draft_forward(
+                dparams, dmc, token_ids, d_positions, chunk_lens,
+                None, None, None, drk, drv, drp,
+            )                                  # dk: [Ld, Hd, b, t, Dd]
+            # Keep only the last min(t, R) chunk tokens per row: their
+            # ring indices (pos % R) are then collision-free, so the
+            # scatter stays deterministic; older tokens fall out of the
+            # ring window exactly as they would during decode.
+            chunk_end = chunk_start + chunk_lens
+            iota_b2 = jnp.arange(b, dtype=jnp.int32)[:, None]
+            keep = in_chunk & (positions >= (chunk_end[:, None] - r_len))
+            widx = jnp.where(
+                keep, iota_b2 * r_len + positions % r_len, b * r_len
+            ).reshape(-1)
+            drk = drk.reshape(dnl, dhkv, b * r_len, ddh).at[
+                :, :, widx
+            ].set(
+                dk.reshape(dnl, dhkv, b * t, ddh), mode="drop"
+            ).reshape(dnl, dhkv, b, r_len, ddh)
+            drv = drv.reshape(dnl, dhkv, b * r_len, ddh).at[
+                :, :, widx
+            ].set(
+                dv.reshape(dnl, dhkv, b * t, ddh), mode="drop"
+            ).reshape(dnl, dhkv, b, r_len, ddh)
+            drp = drp.reshape(-1).at[widx].set(
+                positions.reshape(-1), mode="drop"
+            ).reshape(b, r_len)
+            spec_k = spec_k.at[:, :, slot_idx].set(drk, mode="drop")
+            spec_v = spec_v.at[:, :, slot_idx].set(drv, mode="drop")
+            spec_pos = spec_pos.at[slot_idx].set(drp, mode="drop")
         # Device-resident last-token vector (final rows' sampled tokens):
         # the first decode dispatch after this prefill may chain from it
         # without a host roundtrip (see _decode_impl).
@@ -1093,7 +1831,7 @@ class ModelRunner:
             next_tokens.astype(jnp.int32)
         )
         return (next_tokens, kv_k, kv_v, kv_ks, kv_vs, lp[0], lp[1], lp[2],
-                last_token)
+                last_token, spec_k, spec_v, spec_pos)
 
     def _issue_prefill(self, batch: ScheduledBatch) -> "DispatchHandle":
         cfg = self.config
@@ -1138,10 +1876,21 @@ class ModelRunner:
         toks = packed[NUM_SCALARS * b + b * mb:].reshape(b, t)
         f32 = sc.view(np.float32)
         u32 = sc.view(np.uint32)
+        if self.spec_n:
+            # Padding rows: out-of-range slot -> scatter-back drops.
+            sc[12, :] = self.spec_num_slots
         for i, s in enumerate(seqs):
             start, ln = batch.chunk_starts[i], batch.chunk_lens[i]
             sc[0, i] = start
             sc[1, i] = ln
+            if self.spec_n:
+                # Cache-hit/restored prefixes never prefill on this
+                # engine, so replay them through the draft first — an
+                # un-warmed ring collapses acceptance on exactly the
+                # cache-friendly workloads speculation should help.
+                self._spec_catch_up(s, start)
+                sc[12, i] = self.spec_slot(s.request_id)
+                self._spec_warmed[s.request_id] = start + ln
             u32[2, i] = _seed_base(s)
             u32[3, i] = len(s.output_token_ids)
             sc[8, i] = s.adapter_idx
@@ -1166,14 +1915,16 @@ class ModelRunner:
             counts = np.zeros((1, 1), np.int32)
 
         kv_ks, kv_vs = self._scale_pool_args()
+        dparams, sp_k, sp_v, sp_p = self._spec_pool_args()
         (next_tokens, self.kv_k, self.kv_v, kv_ks2, kv_vs2, lp_c, lp_t,
-         lp_i, last_token) = self._prefill(
+         lp_i, last_token, sp_k2, sp_v2, sp_p2) = self._prefill(
             self.params, jnp.asarray(packed), self.kv_k, self.kv_v,
-            kv_ks, kv_vs, jnp.asarray(counts),
+            kv_ks, kv_vs, jnp.asarray(counts), dparams, sp_k, sp_v, sp_p,
             b=b, t=t, mb=mb, has_window=has_window, b_max=self._b_max,
             has_penalties=has_penalties, logprobs_k=logprobs_k,
         )
         self._rebind_scale_pools(kv_ks2, kv_vs2)
+        self._rebind_spec_pools(sp_k2, sp_v2, sp_p2)
         if self.kv_quantized:
             self.kv_quant_tokens_written += sum(batch.chunk_lens)
         # Final rows' sampled tokens are chainable by the next decode
@@ -1575,17 +2326,19 @@ class ModelRunner:
                         (db, mc.vocab_size) if pen else (1, 1), jnp.int32
                     )
                     kv_ks, kv_vs = self._scale_pool_args()
+                    dparams, sp_k, sp_v, sp_p = self._spec_pool_args()
                     out = self._decode(
                         self.params,
                         jnp.zeros((NUM_SCALARS * db + db * mb,), jnp.int32),
                         self.kv_k, self.kv_v, kv_ks, kv_vs, wk, wv, counts,
-                        self._zero_last,
+                        self._zero_last, dparams, sp_k, sp_v, sp_p,
                         b=db, mb=mb, num_steps=dk,
                         use_cached_window=cached,
                         has_penalties=pen, logprobs_k=lpk,
                     )
                     _, self.kv_k, self.kv_v = out[0], out[1], out[2]
                     self._rebind_scale_pools(out[3], out[4])
+                    self._rebind_spec_pools(out[14], out[15], out[16])
                     if self.attn_impl != "paged":
                         # Both variants return the (appended/gathered)
                         # windows; the inputs were donated, so rebind.
@@ -1612,19 +2365,37 @@ class ModelRunner:
                         (pb, mc.vocab_size) if pen else (1, 1), jnp.int32
                     )
                     kv_ks, kv_vs = self._scale_pool_args()
+                    dparams, sp_k, sp_v, sp_p = self._spec_pool_args()
                     out = self._prefill(
                         self.params,
                         jnp.zeros(
                             (NUM_SCALARS * pb + pb * mb + pb * t,), jnp.int32
                         ),
                         self.kv_k, self.kv_v, kv_ks, kv_vs, counts,
+                        dparams, sp_k, sp_v, sp_p,
                         b=pb, t=t, mb=mb, has_window=has_window,
                         b_max=self._b_max,
                         has_penalties=pen, logprobs_k=lpk,
                     )
                     self.kv_k, self.kv_v = out[1], out[2]
                     self._rebind_scale_pools(out[3], out[4])
+                    self._rebind_spec_pools(out[9], out[10], out[11])
                     n_warmed += 1
+            if self.spec_n:
+                # Draft catch-up (ingest) families: one per T bucket, so
+                # a mid-serving cache-hit prompt never pays the compile.
+                t_ing = 16
+                t_max = max(16, 1 << (self.spec_ring_len - 1).bit_length())
+                while t_ing <= t_max:
+                    self.spec_k, self.spec_v, self.spec_pos = \
+                        self._spec_ingest_jit(
+                            self.spec_params, self.spec_k, self.spec_v,
+                            self.spec_pos, jnp.int32(0),
+                            jnp.zeros((t_ing,), jnp.int32), jnp.int32(0),
+                            jnp.int32(0), t=t_ing,
+                        )
+                    n_warmed += 1
+                    t_ing *= 2
             # Warmup dispatches block-wait on the last output so compile
             # failures surface here, not mid-serving.
             jax.block_until_ready(self.kv_k)
@@ -1652,3 +2423,11 @@ class ModelRunner:
                     "Rebuilding KV pool consumed by failed warmup"
                 )
                 self._alloc_kv_pools()
+            if self.spec_n:
+                try:
+                    spec_gone = (self.spec_k.is_deleted()
+                                 or self.spec_pos.is_deleted())
+                except Exception:  # noqa: BLE001 — treat unprobeable as gone
+                    spec_gone = True
+                if spec_gone:
+                    self._alloc_spec_pools()

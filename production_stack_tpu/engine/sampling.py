@@ -102,6 +102,35 @@ def apply_penalties(
     )
 
 
+def speculative_accept(
+    proposals: jax.Array,    # [B, N] int32 — draft tokens for positions 1..N
+    samples: jax.Array,      # [B, N+1] int32 — the target's own (seeded)
+                             # samples at verify positions 0..N
+    budget: jax.Array,       # [B] int32 — tokens the row may still emit
+) -> tuple:
+    """Deterministic accept/emit accounting for one draft/verify cycle
+    (docs/PERF.md round 8). Proposal i is accepted iff it EQUALS the token
+    the target itself would have sampled at that position (``samples[i]``,
+    drawn with the accepted-gen-index seed schedule) AND every earlier
+    proposal was accepted — so the emitted stream is token-identical to
+    spec-off by construction: accepted proposals ARE the target's samples,
+    and the first mismatch is corrected by the target's sample at that
+    position (the "bonus" token, always emittable because verify scored
+    position a's logits under a fully-accepted prefix).
+
+    Returns (emit [B], accepted [B]):
+      * emit     — tokens the row emits this cycle: min(accepted + 1,
+                   budget); the emitted tokens are samples[:emit].
+                   0 when the row's budget is exhausted.
+      * accepted — draft proposals that survived (before budget clipping);
+                   the telemetry numerator (acceptance = accepted / N).
+    """
+    agree = (proposals == samples[:, :-1]).astype(jnp.int32)     # [B, N]
+    accepted = jnp.cumprod(agree, axis=1).sum(axis=1)            # [B]
+    emit = jnp.minimum(accepted + 1, jnp.maximum(budget, 0))
+    return emit, accepted
+
+
 def _gumbel(seeds: jax.Array, shape) -> jax.Array:
     """Per-row Gumbel noise: row i uses PRNGKey(seeds[i])."""
     return jax.vmap(

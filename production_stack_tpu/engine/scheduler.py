@@ -657,6 +657,17 @@ class Scheduler:
                 if not self._apply_valid(seq, batch.epochs[i]):
                     continue
                 seq.inflight_steps -= batch.decode_steps[i]
+                if self.config.speculative_num_tokens:
+                    # A speculative dispatch emits a VARIABLE token count
+                    # (acceptance-dependent, <= the budgeted steps);
+                    # advance_at_issue advanced by the full budget, so
+                    # reconcile the KV position to what the device
+                    # actually committed. Safe because the speculative
+                    # engine loop is strictly ordered (no other dispatch
+                    # is issued between this one's issue and apply).
+                    seq.num_computed_tokens -= max(
+                        0, batch.decode_steps[i] - len(toks)
+                    )
                 took = False
                 lps = logprob_lists[i] if logprob_lists else None
                 for j, tok in enumerate(toks):

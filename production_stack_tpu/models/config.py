@@ -110,6 +110,16 @@ LLAMA_1B = ModelConfig(
     name="llama-1b",
 )
 
+# Tiny OPT-family config sharing tiny-llama's 512-token vocabulary, so CPU
+# tests can pair them as a speculative draft/target (docs/PERF.md round 8):
+# draft proposals are accepted by token id, which requires one shared
+# tokenizer/vocab across the pair (both resolve to the same ByteTokenizer).
+TINY_OPT = ModelConfig(
+    arch="opt", vocab_size=512, hidden_size=64, intermediate_size=128,
+    num_layers=2, num_heads=2, num_kv_heads=2, max_position_embeddings=512,
+    tie_word_embeddings=True, name="tiny-opt",
+)
+
 # facebook/opt-125m architecture (reference parity config #1, BASELINE.json).
 OPT_125M = ModelConfig(
     arch="opt", vocab_size=50272, hidden_size=768, intermediate_size=3072,
@@ -147,6 +157,7 @@ NAMED_CONFIGS = {
     "tiny-llama": TINY_LLAMA,
     "tiny-llama-8kv": TINY_LLAMA_8KV,
     "tiny-llama-128dh": TINY_LLAMA_128DH,
+    "tiny-opt": TINY_OPT,
     "llama-1b": LLAMA_1B,
     "llama-3b": LLAMA32_3B,
     "facebook/opt-125m": OPT_125M,

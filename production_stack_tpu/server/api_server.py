@@ -1209,6 +1209,10 @@ def build_engine_from_args(args: argparse.Namespace) -> ServingEngine:
         **({"decode_loop": args.decode_loop}
            if args.decode_loop is not None else {}),
         attn_impl=args.attn_impl,
+        speculative_num_tokens=args.speculative_num_tokens,
+        speculative_model=args.speculative_model,
+        **({"speculative_draft_window": args.speculative_draft_window}
+           if args.speculative_draft_window is not None else {}),
         enable_warmup=not args.no_warmup,
         overlap_dispatch=not args.no_overlap_dispatch,
         pipeline_depth=args.pipeline_depth,
@@ -1285,6 +1289,24 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="Max dispatches outstanding on device at once "
                         "(EngineConfig.pipeline_depth; 1 = no pipelining; "
                         "clamped to 2)")
+    p.add_argument("--speculative-num-tokens", type=int, default=0,
+                   help="speculative decoding: draft-ahead tokens per "
+                        "target step inside the fused decode scan (0 "
+                        "disables; docs/PERF.md round 8). Spec-on output "
+                        "is token-identical to spec-off for greedy and "
+                        "seeded sampling; requires --speculative-model, "
+                        "the window attention path, bf16 KV cache, and "
+                        "tp=sp=1")
+    p.add_argument("--speculative-model", default=None,
+                   help="draft model for speculative decoding (name or "
+                        "HF dir); must share the target's vocabulary — "
+                        "a mismatch is a clean startup error")
+    p.add_argument("--speculative-draft-window", type=int, default=None,
+                   help="draft-KV ring length in tokens per sequence "
+                        "(default: EngineConfig tuned value, 1024; 0 = "
+                        "full context, highest acceptance but ring memory "
+                        "scales with max_model_len x slots; smaller "
+                        "bounds draft memory at an acceptance-only cost)")
     p.add_argument("--lora-modules", nargs="*", default=[],
                    metavar="NAME=PATH",
                    help="LoRA adapters to serve (vLLM convention): "

@@ -26,7 +26,9 @@ def render_engine_metrics(engine: "ServingEngine", model_name: str) -> str:
                 "kv_quant_bytes_saved_total", "queue_depth",
                 "prefix_index_size", "kv_restore_saved_tokens_total",
                 "kv_shared_tier_hits_total", "kv_shared_tier_misses_total",
-                "kv_chain_evictions_total", "resume_restored_tokens_total"):
+                "kv_chain_evictions_total", "resume_restored_tokens_total",
+                "spec_enabled", "spec_draft_tokens_total",
+                "spec_accepted_tokens_total", "spec_acceptance_rate"):
         s.setdefault(key, 0)
     s.setdefault("disagg_role", "unified")
     s.setdefault("kv_cache_dtype", "bfloat16")
@@ -110,6 +112,28 @@ def render_engine_metrics(engine: "ServingEngine", model_name: str) -> str:
         "# TYPE pstpu:resume_restored_tokens_total counter",
         f"pstpu:resume_restored_tokens_total{label} "
         f"{s['resume_restored_tokens_total']}",
+        # Speculative decoding (docs/PERF.md round 8): whether the draft
+        # path is active, draft proposals made/accepted, and the lifetime
+        # acceptance rate (the collector renders the same four series).
+        "# HELP pstpu:spec_enabled Speculative decoding active "
+        "(--speculative-num-tokens > 0)",
+        "# TYPE pstpu:spec_enabled gauge",
+        f"pstpu:spec_enabled{label} {s['spec_enabled']}",
+        "# HELP pstpu:spec_draft_tokens_total Draft-model token proposals "
+        "made inside fused decode dispatches",
+        "# TYPE pstpu:spec_draft_tokens_total counter",
+        f"pstpu:spec_draft_tokens_total{label} "
+        f"{s['spec_draft_tokens_total']}",
+        "# HELP pstpu:spec_accepted_tokens_total Draft proposals that "
+        "survived target verification (bonus tokens not counted)",
+        "# TYPE pstpu:spec_accepted_tokens_total counter",
+        f"pstpu:spec_accepted_tokens_total{label} "
+        f"{s['spec_accepted_tokens_total']}",
+        "# HELP pstpu:spec_acceptance_rate Lifetime fraction of draft "
+        "proposals accepted by the target",
+        "# TYPE pstpu:spec_acceptance_rate gauge",
+        f"pstpu:spec_acceptance_rate{label} "
+        f"{s['spec_acceptance_rate']:.6f}",
         # Two-slot dispatch-pipeline telemetry (engine.py:_run_loop): the
         # prefill/decode overlap win is observable, not asserted.
         "# HELP pstpu:decode_dispatches_total Fused decode dispatches issued",

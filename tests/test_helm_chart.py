@@ -104,6 +104,56 @@ def test_engine_args_parse_with_real_parser():
         == "tpu-v5-lite-podslice"
 
 
+def test_speculative_tpu_config_renders_engine_flags():
+    """tpuConfig.speculativeNumTokens/speculativeModel render the
+    --speculative-* engine flags (docs/PERF.md round 8) and the result
+    parses with the real engine CLI parser."""
+    values = {
+        "servingEngineSpec": {
+            "runtimeClassName": "",
+            "modelSpec": [{
+                "name": "spec",
+                "repository": "production-stack-tpu/engine",
+                "tag": "latest",
+                "modelURL": "llama-1b",
+                "replicaCount": 1,
+                "requestCPU": 4,
+                "requestMemory": "16Gi",
+                "requestGPU": 1,
+                "tpuConfig": {
+                    "speculativeNumTokens": 3,
+                    "speculativeModel": "facebook/opt-125m",
+                    "speculativeDraftWindow": 512,
+                },
+            }],
+        },
+    }
+    manifests = render_chart(CHART, values=values, release_name="stack")
+    engine = next(
+        m for m in _by_kind(manifests, "Deployment")
+        if m["metadata"]["name"].endswith("deployment-engine")
+    )
+    args = [str(a) for a in _container(engine, "engine")["args"]]
+    assert args[args.index("--speculative-num-tokens") + 1] == "3"
+    assert args[args.index("--speculative-model") + 1] == \
+        "facebook/opt-125m"
+    from production_stack_tpu.server.api_server import (
+        parse_args as engine_parse_args,
+    )
+
+    ns = engine_parse_args(args)
+    assert ns.speculative_num_tokens == 3
+    assert ns.speculative_model == "facebook/opt-125m"
+    assert ns.speculative_draft_window == 512
+    # And the knobs satisfy the published schema.
+    jsonschema = pytest.importorskip("jsonschema")
+    import json
+
+    with open(os.path.join(CHART, "values.schema.json")) as f:
+        schema = json.load(f)
+    jsonschema.validate(values, schema)
+
+
 def test_lmcache_env_contract():
     manifests = render_chart(CHART, values_file=EXAMPLES[3],  # values-06
                              release_name="stack")

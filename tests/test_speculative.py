@@ -1,0 +1,401 @@
+"""Speculative decoding (docs/PERF.md round 8).
+
+The hard bar: spec-on must be TOKEN-IDENTICAL to spec-off for greedy and
+seeded sampling — including a stop string landing inside a draft window
+and a PR-9 mid-stream resume of a spec-on stream. Two draft shapes are
+exercised: a SELF-draft (identical weights — acceptance ~1, the
+mechanism-proof/bench configuration) and a cross-arch tiny-opt draft
+(uncorrelated random weights — acceptance ~0, which drives the pure
+rejection path hard; output must STILL match spec-off exactly because
+every emitted token is the target's own sample).
+
+Config validation is parse-time: a vocab-mismatched draft must be a
+clean startup error, never a mid-scan shape crash.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.engine import ServingEngine
+from production_stack_tpu.engine.runner import resolved_seed_base
+from production_stack_tpu.engine.sampling import (
+    SamplingParams,
+    speculative_accept,
+)
+
+BASE = dict(
+    model="tiny-llama", max_model_len=256, block_size=4, num_kv_blocks=128,
+    max_num_seqs=8, max_num_batched_tokens=32, attn_impl="window",
+    dtype="float32", num_decode_steps=8,
+)
+
+
+# --------------------------------------------------------------------------
+# Parse-time validation (satellite: clean startup error, not a shape crash)
+# --------------------------------------------------------------------------
+def test_vocab_mismatched_draft_is_a_clean_config_error():
+    with pytest.raises(ValueError, match="vocab"):
+        EngineConfig(**BASE, speculative_num_tokens=3,
+                     speculative_model="facebook/opt-125m")
+
+
+def test_spec_requires_a_draft_model():
+    with pytest.raises(ValueError, match="speculative-model"):
+        EngineConfig(**BASE, speculative_num_tokens=3)
+
+
+def test_spec_rejects_int8_kv_cache():
+    cfg = dict(BASE)
+    cfg["kv_cache_dtype"] = "int8"
+    with pytest.raises(ValueError, match="bfloat16"):
+        EngineConfig(**cfg, speculative_num_tokens=3,
+                     speculative_model="tiny-llama")
+
+
+def test_spec_rejects_tensor_parallel():
+    cfg = dict(BASE)
+    cfg["tensor_parallel_size"] = 2
+    with pytest.raises(ValueError, match="tensor_parallel"):
+        EngineConfig(**cfg, speculative_num_tokens=3,
+                     speculative_model="tiny-llama")
+
+
+def test_spec_rejects_explicit_paged_attn():
+    cfg = dict(BASE)
+    cfg["attn_impl"] = "paged"
+    from production_stack_tpu.models.config import resolve_model_config
+
+    ec = EngineConfig(**{**cfg, "model": "tiny-llama-128dh"},
+                      speculative_num_tokens=3,
+                      speculative_model="tiny-llama-128dh")
+    with pytest.raises(ValueError, match="window"):
+        ec.resolved_attn_impl(resolve_model_config("tiny-llama-128dh"))
+
+
+def test_spec_auto_attn_resolves_to_window():
+    from production_stack_tpu.models.config import resolve_model_config
+
+    ec = EngineConfig(**BASE, speculative_num_tokens=3,
+                      speculative_model="tiny-llama")
+    assert ec.resolved_attn_impl(
+        resolve_model_config("tiny-llama")
+    ) == "window"
+
+
+# --------------------------------------------------------------------------
+# Acceptance accounting math (satellite: pinned on synthetic traces)
+# --------------------------------------------------------------------------
+def _accept(props, samples, budget):
+    emit, acc = speculative_accept(
+        np.asarray(props, np.int32), np.asarray(samples, np.int32),
+        np.asarray(budget, np.int32),
+    )
+    return np.asarray(emit).tolist(), np.asarray(acc).tolist()
+
+
+def test_accept_full_agreement_emits_bonus_token():
+    # proposals match samples[:-1] exactly -> all N accepted + 1 bonus.
+    emit, acc = _accept([[5, 6, 7]], [[5, 6, 7, 8]], [10])
+    assert (emit, acc) == ([4], [3])
+
+
+def test_accept_first_mismatch_truncates_prefix():
+    # q1 wrong -> only q0 accepted; the emitted stream is samples[:2].
+    emit, acc = _accept([[5, 9, 7]], [[5, 6, 7, 8]], [10])
+    assert (emit, acc) == ([2], [1])
+
+
+def test_accept_post_rejection_agreement_never_resurrects():
+    # q2 agrees again AFTER the q1 mismatch — its context was wrong, so
+    # the cumulative-prefix rule must not count it.
+    emit, acc = _accept([[5, 9, 7]], [[5, 6, 7, 8]], [10])
+    assert acc == [1]
+    emit2, acc2 = _accept([[9, 6, 7]], [[5, 6, 7, 8]], [10])
+    assert (emit2, acc2) == ([1], [0])
+
+
+def test_accept_budget_clips_emission():
+    emit, acc = _accept([[5, 6, 7]], [[5, 6, 7, 8]], [2])
+    assert emit == [2]          # accepted 3 but only 2 tokens of budget
+    emit0, _ = _accept([[5, 6, 7]], [[5, 6, 7, 8]], [0])
+    assert emit0 == [0]         # exhausted row emits nothing
+
+
+def test_accept_is_per_row():
+    emit, acc = _accept(
+        [[1, 2, 3], [1, 2, 3]],
+        [[1, 2, 3, 4], [9, 2, 3, 4]],
+        [10, 10],
+    )
+    assert (emit, acc) == ([4, 1], [3, 0])
+
+
+# --------------------------------------------------------------------------
+# Engines under test (module-scoped: compile once, reuse across tests)
+# --------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def engines():
+    loop = asyncio.new_event_loop()
+    eng = {
+        "off": ServingEngine(EngineConfig(**BASE)),
+        "self": ServingEngine(EngineConfig(
+            **BASE, speculative_num_tokens=3,
+            speculative_model="tiny-llama",
+        )),
+        "opt": ServingEngine(EngineConfig(
+            **BASE, speculative_num_tokens=3,
+            speculative_model="tiny-opt",
+        )),
+    }
+    for e in eng.values():
+        loop.run_until_complete(e.start())
+    yield eng, loop
+    for e in eng.values():
+        loop.run_until_complete(e.stop())
+    loop.close()
+
+
+async def _collect(engine, prompt, sampling, request_id, **kw):
+    text, outs = "", []
+    async for out in engine.generate(
+        prompt=prompt, sampling=sampling, request_id=request_id, **kw
+    ):
+        text += out.text_delta
+        outs.append(out)
+    return text, outs
+
+
+def _run(loop, engine, prompt, sampling, request_id, **kw):
+    return loop.run_until_complete(
+        _collect(engine, prompt, sampling, request_id, **kw)
+    )
+
+
+# --------------------------------------------------------------------------
+# Draft-model plumbing (satellite: fast tier-1)
+# --------------------------------------------------------------------------
+def test_draft_plumbing_cross_arch_draft_loads_and_counts(engines):
+    eng, loop = engines
+    e = eng["opt"]
+    # Draft + target resolved and loaded side by side.
+    assert e.runner.spec_draft_config.arch == "opt"
+    assert e.runner.spec_draft_config.vocab_size == \
+        e.model_config.vocab_size
+    before = e.runner.spec_draft_tokens_total
+    _, outs = _run(loop, e, "plumbing check", SamplingParams(
+        temperature=0.0, max_tokens=9, ignore_eos=True), "plumb-1")
+    assert outs[-1].num_output_tokens == 9
+    st = e.stats()
+    assert st["spec_enabled"] == 1
+    # Proposals were made in multiples of N, and acceptance is a valid
+    # fraction of them.
+    made = st["spec_draft_tokens_total"] - before
+    assert made > 0 and made % 3 == 0
+    assert 0 <= st["spec_accepted_tokens_total"] <= \
+        st["spec_draft_tokens_total"]
+    assert 0.0 <= st["spec_acceptance_rate"] <= 1.0
+    # The finished stream returned its draft-ring slot.
+    assert "plumb-1" not in e.runner._spec_slots
+
+
+def test_spec_off_engine_reports_disabled(engines):
+    eng, _ = engines
+    st = eng["off"].stats()
+    assert st["spec_enabled"] == 0
+    assert st["spec_draft_tokens_total"] == 0
+    assert st["spec_acceptance_rate"] == 0.0
+
+
+def test_both_metrics_renderers_export_spec_series(engines):
+    eng, _ = engines
+    from production_stack_tpu.engine.metrics import EngineMetricsCollector
+    from production_stack_tpu.server.metrics import render_engine_metrics
+
+    text = render_engine_metrics(eng["self"], "m")
+    for name in ("pstpu:spec_enabled", "pstpu:spec_draft_tokens_total",
+                 "pstpu:spec_accepted_tokens_total",
+                 "pstpu:spec_acceptance_rate"):
+        assert name in text, name
+    assert 'pstpu:spec_enabled{model_name="m"} 1' in text
+    collected = {
+        m.name for m in EngineMetricsCollector(eng["self"]).collect()
+    }
+    # prometheus_client strips the _total suffix from counters.
+    for name in ("pstpu:spec_enabled", "pstpu:spec_draft_tokens",
+                 "pstpu:spec_accepted_tokens",
+                 "pstpu:spec_acceptance_rate"):
+        assert name in collected, name
+
+
+# --------------------------------------------------------------------------
+# Parity: the hard bar (fast single-stream greedy/seeded stay in tier-1)
+# --------------------------------------------------------------------------
+GREEDY = dict(temperature=0.0, max_tokens=24, ignore_eos=True)
+SEEDED = dict(temperature=0.9, seed=1234, max_tokens=24, ignore_eos=True)
+
+
+def test_parity_greedy_self_draft_high_acceptance(engines):
+    eng, loop = engines
+    _, off = _run(loop, eng["off"], "greedy parity prompt",
+                  SamplingParams(**GREEDY), "pg-off")
+    before = eng["self"].runner.spec_accepted_tokens_total
+    _, on = _run(loop, eng["self"], "greedy parity prompt",
+                 SamplingParams(**GREEDY), "pg-self")
+    assert on[-1].token_ids == off[-1].token_ids
+    # Identical weights + full-context draft ring: acceptance is high,
+    # so the machinery emitted >1 token per target step.
+    assert eng["self"].runner.spec_accepted_tokens_total > before
+
+
+def test_parity_greedy_cross_draft_pure_rejection(engines):
+    eng, loop = engines
+    _, off = _run(loop, eng["off"], "rejection parity prompt",
+                  SamplingParams(**GREEDY), "pr-off")
+    _, on = _run(loop, eng["opt"], "rejection parity prompt",
+                 SamplingParams(**GREEDY), "pr-opt")
+    # Uncorrelated draft: most proposals are rejected — emitted tokens
+    # must STILL be exactly the target's stream.
+    assert on[-1].token_ids == off[-1].token_ids
+
+
+def test_parity_seeded_sampling_both_drafts(engines):
+    eng, loop = engines
+    _, off = _run(loop, eng["off"], "seeded parity prompt",
+                  SamplingParams(**SEEDED), "ps-off")
+    _, on_self = _run(loop, eng["self"], "seeded parity prompt",
+                      SamplingParams(**SEEDED), "ps-self")
+    _, on_opt = _run(loop, eng["opt"], "seeded parity prompt",
+                     SamplingParams(**SEEDED), "ps-opt")
+    assert on_self[-1].token_ids == off[-1].token_ids
+    assert on_opt[-1].token_ids == off[-1].token_ids
+
+
+def test_parity_logprobs_bookkeeping(engines):
+    eng, loop = engines
+    sp = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True,
+                        logprobs=3)
+    _, off = _run(loop, eng["off"], "logprob parity", sp, "lp-off")
+    _, on = _run(loop, eng["self"], "logprob parity", sp, "lp-on")
+    assert on[-1].token_ids == off[-1].token_ids
+    lps_off, lps_on = off[-1].logprobs, on[-1].logprobs
+    assert len(lps_on) == len(lps_off) == 8
+    for (c_off, top_off), (c_on, top_on) in zip(lps_off, lps_on):
+        assert [t for t, _ in top_on] == [t for t, _ in top_off]
+        assert c_on == pytest.approx(c_off, abs=1e-4)
+
+
+def test_variable_budgets_and_concurrency(engines):
+    """Co-batched spec rows with different max_tokens: budget clipping
+    inside the accept step must stop each row at ITS budget, and outputs
+    must match the spec-off engine run with the same concurrency."""
+    eng, loop = engines
+
+    async def batch(e, tag):
+        return await asyncio.gather(
+            _collect(e, "stream one", SamplingParams(
+                temperature=0.0, max_tokens=3, ignore_eos=True),
+                f"{tag}-a"),
+            _collect(e, "stream two", SamplingParams(
+                temperature=0.0, max_tokens=13, ignore_eos=True),
+                f"{tag}-b"),
+            _collect(e, "stream three", SamplingParams(
+                temperature=0.0, max_tokens=22, ignore_eos=True),
+                f"{tag}-c"),
+        )
+    off = loop.run_until_complete(batch(eng["off"], "vb-off"))
+    on = loop.run_until_complete(batch(eng["self"], "vb-on"))
+    for (_, o), (_, s) in zip(off, on):
+        assert s[-1].token_ids == o[-1].token_ids
+    assert [s[-1].num_output_tokens for _, s in on] == [3, 13, 22]
+
+
+# --------------------------------------------------------------------------
+# Stop strings + resume across the spec window (e2e; slow tier)
+# --------------------------------------------------------------------------
+@pytest.mark.slow
+def test_stop_string_inside_a_draft_window(engines):
+    """Pick a stop string from the greedy output so the match lands
+    mid-generation — inside some draft/verify window — and assert the
+    spec-on truncation matches spec-off byte for byte."""
+    eng, loop = engines
+    sp = SamplingParams(temperature=0.0, max_tokens=40, ignore_eos=True)
+    base_text, base = _run(loop, eng["off"], "tell me a story", sp,
+                           "stop-base")
+    assert len(base_text) > 8
+    mid = len(base_text) // 2
+    stop = base_text[mid:mid + 3]
+    idx = base_text.find(stop)
+    assert idx > 0
+    sp_stop = SamplingParams(temperature=0.0, max_tokens=40,
+                             ignore_eos=True, stop=[stop])
+    off_text, off = _run(loop, eng["off"], "tell me a story", sp_stop,
+                         "stop-off")
+    on_text, on = _run(loop, eng["self"], "tell me a story", sp_stop,
+                       "stop-on")
+    assert on_text == off_text == base_text[:idx]
+    assert on[-1].token_ids == off[-1].token_ids
+    assert on[-1].finish_reason == off[-1].finish_reason == "stop"
+
+
+@pytest.mark.slow
+def test_resume_of_a_spec_on_stream_is_token_identical(engines):
+    """PR-9 contract: resume replays ACCEPTED tokens only (the host never
+    saw rejected drafts), so resuming a spec-on stream — on a spec-on
+    engine — continues token-identically from the delivered prefix."""
+    eng, loop = engines
+    sp = SamplingParams(temperature=0.0, max_tokens=14, ignore_eos=True)
+    _, full = _run(loop, eng["self"], "resume a speculative stream", sp,
+                   "sr-full")
+    toks = full[-1].token_ids
+    assert len(toks) == 14
+    _, res = _run(
+        loop, eng["self"], "resume a speculative stream", sp, "sr-res",
+        resume_tokens=toks[:5],
+        resume_seed=resolved_seed_base("sr-full", sp),
+    )
+    assert res[-1].token_ids == toks
+    assert res[-1].num_output_tokens == 14
+    # And the same resume served by a spec-OFF engine matches too (the
+    # wire contract is engine-config-agnostic).
+    _, res_off = _run(
+        loop, eng["off"], "resume a speculative stream", sp, "sr-res-off",
+        resume_tokens=toks[:5],
+        resume_seed=resolved_seed_base("sr-full", sp),
+    )
+    assert res_off[-1].token_ids == toks
+
+
+@pytest.mark.slow
+def test_preemption_recompute_under_spec(engines):
+    """A spec engine starved of KV blocks preempts and re-prefills; the
+    draft ring resets on the fresh chunk 0 and output stays identical to
+    the unpressured spec-off run."""
+    loop = asyncio.new_event_loop()
+    tight = dict(BASE)
+    tight["num_kv_blocks"] = 24  # tight pool: forces preemption
+    e_on = ServingEngine(EngineConfig(
+        **tight, speculative_num_tokens=3, speculative_model="tiny-llama"))
+    e_off = ServingEngine(EngineConfig(**tight))
+    loop.run_until_complete(e_on.start())
+    loop.run_until_complete(e_off.start())
+    try:
+        async def pair(e, tag):
+            return await asyncio.gather(
+                _collect(e, "pressure stream alpha", SamplingParams(
+                    temperature=0.0, max_tokens=20, ignore_eos=True),
+                    f"{tag}-a"),
+                _collect(e, "pressure stream beta", SamplingParams(
+                    temperature=0.0, max_tokens=20, ignore_eos=True),
+                    f"{tag}-b"),
+            )
+        off = loop.run_until_complete(pair(e_off, "pp-off"))
+        on = loop.run_until_complete(pair(e_on, "pp-on"))
+        for (_, o), (_, s) in zip(off, on):
+            assert s[-1].token_ids == o[-1].token_ids
+    finally:
+        loop.run_until_complete(e_on.stop())
+        loop.run_until_complete(e_off.stop())
+        loop.close()

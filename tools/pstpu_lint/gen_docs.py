@@ -33,6 +33,7 @@ TABLES = {
     "resume": "docs/RESILIENCE.md",
     "autoscaling": "docs/SOAK.md",
     "kv-economy": "docs/KV_ECONOMY.md",
+    "speculative": "docs/PERF.md",
 }
 
 FLAG_TABLES = {

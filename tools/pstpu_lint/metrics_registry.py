@@ -135,6 +135,21 @@ REGISTRY: Tuple[Series, ...] = (
     Series("pstpu:kv_chain_evictions_total", "counter", ("model_name",),
            _BOTH_ENGINE, ("catalogue", "kv-economy"),
            "Leaf-first chain evictions in the local host KV tier"),
+    # --------------------------------------------- engine: speculative
+    Series("pstpu:spec_enabled", "gauge", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "speculative"),
+           "Speculative decoding active (--speculative-num-tokens > 0)"),
+    Series("pstpu:spec_draft_tokens_total", "counter", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "speculative"),
+           "Draft-model token proposals made inside fused decode "
+           "dispatches"),
+    Series("pstpu:spec_accepted_tokens_total", "counter", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "speculative"),
+           "Draft proposals that survived target verification (bonus "
+           "tokens not counted)"),
+    Series("pstpu:spec_acceptance_rate", "gauge", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "speculative"),
+           "Lifetime fraction of draft proposals accepted by the target"),
     # --------------------------------------------- engine: mid-stream resume
     Series("pstpu:resume_restored_tokens_total", "counter", ("model_name",),
            _BOTH_ENGINE, ("catalogue", "resume"),
