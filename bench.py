@@ -37,7 +37,8 @@ PEAK_HBM_GBS = float(os.environ.get("PSTPU_PEAK_HBM_GBS", 819.0))
 def roofline_components(model: str, weight_dtype_bytes: float,
                         kv_cache_dtype: str, batch: int, avg_ctx: float,
                         peak_gbs: float = None,
-                        tokens_per_target_step: float = 1.0) -> dict:
+                        tokens_per_target_step: float = 1.0,
+                        num_chips: int = 1) -> dict:
     """Aggregate decode roofline from the model's analytic byte counts —
     WEIGHT bytes (compute dtype, amortized over the batch) split from KV
     bytes (the KV-CACHE storage dtype + per-slot scale overhead, per row):
@@ -50,11 +51,20 @@ def roofline_components(model: str, weight_dtype_bytes: float,
     round 8). Each target step still streams the same weight+KV bytes,
     but they amortize over that many emitted tokens, so the effective
     tokens/sec ceiling scales by the factor (the draft model's own bytes
-    are deliberately excluded — the draft is sized to be negligible)."""
+    are deliberately excluded — the draft is sized to be negligible).
+
+    ``num_chips``: devices the serving mesh occupies (tp x sp x dp). The
+    aggregate HBM roofline scales with the chip count — each tp shard
+    streams 1/tp of the weights and 1/tp of the KV per step over its OWN
+    HBM, so the denominator's bytes-per-chip shrink by the chip count
+    (equivalently: peak bandwidth multiplies). Without this the
+    ``hbm_bw_pct`` of a tp>1 run would flatter itself against a
+    single-chip ceiling (docs/PERF.md round 9)."""
     from production_stack_tpu.engine.config import EngineConfig
     from production_stack_tpu.models.config import resolve_model_config
 
     peak = PEAK_HBM_GBS if peak_gbs is None else peak_gbs
+    peak *= max(1, int(num_chips))
     mc = resolve_model_config(model)
     d, f, v = mc.hidden_size, mc.intermediate_size, mc.vocab_size
     dh, h, hkv, nl = mc.head_dim_, mc.num_heads, mc.num_kv_heads, mc.num_layers
@@ -72,6 +82,7 @@ def roofline_components(model: str, weight_dtype_bytes: float,
         "kv_bytes_per_token": kv_bytes_per_token,
         "kv_bytes_per_step_per_row": kv_bytes_per_token * avg_ctx,
         "tokens_per_target_step": factor,
+        "num_chips": max(1, int(num_chips)),
         "roofline_tok_s": peak * 1e9 / step_bytes_per_row * factor,
     }
 
@@ -210,6 +221,8 @@ def bench_stack(args) -> dict:
             "--max-num-seqs", str(max(8, args.users)),
             "--attn-impl", args.attn_impl,
             "--kv-cache-dtype", args.kv_cache_dtype,
+            *(["--no-warmup"]
+              if getattr(args, "no_engine_warmup", False) else []),
             *(["--decode-loop", args.decode_loop]
               if args.decode_loop else []),
             *(["--no-overlap-dispatch"] if args.no_overlap else []),
@@ -228,6 +241,7 @@ def bench_stack(args) -> dict:
         router_args=router_args,
         num_engines=args.num_engines,
         engine_env=engine_env,
+        tensor_parallel_size=getattr(args, "tensor_parallel_size", 1),
     )
     try:
         cfg = WorkloadConfig(
@@ -272,8 +286,10 @@ def bench_stack(args) -> dict:
             f"logs: {stack.log_paths}"
         )
     avg_prompt = summary["total_prompt_tokens"] / summary["finished_requests"]
+    chips = (max(1, getattr(args, "tensor_parallel_size", 1))
+             * max(1, getattr(args, "num_engines", 1)))
     return {
-        "metric": f"stack_output_throughput_{args.model}_1chip",
+        "metric": f"stack_output_throughput_{args.model}_{chips}chip",
         "value": round(summary["output_tokens_per_s"], 2),
         "summary": summary,
         "avg_prompt_tokens": avg_prompt,
@@ -385,6 +401,102 @@ def bench_disagg(args) -> dict:
         "avg_prompt_tokens": avg_prompt,
         "kv_hit_rate": round((h1 - h0) / max(1.0, q1 - q0), 4),
         "disagg": disagg,
+    }
+
+
+# ----------------------------------------------------------- multichip mode
+def _force_virtual_devices(args, need: int) -> None:
+    """CPU backend: expose a virtual multi-device platform to this process
+    AND every engine subprocess it spawns (they inherit the environment).
+    The same serving code path on a TPU slice sees the real devices and
+    needs none of this. Idempotent; pinned to 8 devices (the CI mesh and
+    every sweep point 1/2/4/8 fit it)."""
+    if args.backend != "cpu" or need <= 1:
+        return
+    import re
+
+    n = max(8, need)
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    elif int(m.group(1)) < n:
+        # A pre-existing smaller count would make the widest sweep point
+        # fail its mesh build after the narrower points already ran.
+        os.environ["XLA_FLAGS"] = flags.replace(
+            m.group(0), f"--xla_force_host_platform_device_count={n}"
+        )
+    # The ambient environment may re-point jax at a real accelerator
+    # platform; the virtual-device flag only exists on the CPU backend.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def bench_multichip_sweep(args) -> dict:
+    """The 1/2/4/8-chip serving scaling curve (docs/PERF.md round 9):
+    bench_stack at each tp point of --multichip-sweep, same workload, with
+    a hard zero-5xx bar per point. The report's ``curve`` is what
+    tools/capacity.py turns into a chips->QPS capacity model."""
+    chip_points = [
+        int(x) for x in str(args.multichip_sweep).split(",") if x.strip()
+    ]
+    if not chip_points:
+        raise ValueError("--multichip-sweep needs a chip list, e.g. 1,2,4,8")
+    _force_virtual_devices(args, max(chip_points))
+    if args.backend == "cpu":
+        # Startup AOT warmup at every tp point would dominate the sweep's
+        # wall clock on CPU; the warmup WORKLOAD pass before each timed
+        # region still compiles every shape the measurement hits.
+        args.no_engine_warmup = True
+    runs = []
+    curve = []
+    base_per_chip = None
+    for chips in chip_points:
+        args.tensor_parallel_size = chips
+        res = bench_stack(args)
+        line = _result_line(args, res)
+        errors = line.get("errors_total", 0)
+        if errors:
+            raise RuntimeError(
+                f"multichip sweep point tp={chips} leaked {errors} "
+                f"client-visible 5xx — a scaling curve over a failing "
+                f"configuration is not serving evidence"
+            )
+        per_chip = line["tok_per_s_per_chip"]
+        if base_per_chip is None:
+            base_per_chip = per_chip or 1.0
+        curve.append({
+            "chips": line["num_chips"],
+            "tok_s": line["value"],
+            "tok_per_s_per_chip": per_chip,
+            "scaling_efficiency": round(per_chip / base_per_chip, 4),
+            "qps": line.get("qps"),
+            "p50_ttft_s": line.get("p50_ttft_s"),
+            "avg_ttft_s": line.get("avg_ttft_s"),
+            "hbm_bw_pct": line.get("hbm_bw_pct"),
+            "finished_requests": line.get("finished_requests"),
+            "errors_total": errors,
+        })
+        runs.append(line)
+        print(json.dumps({"sweep_point": curve[-1]}), file=sys.stderr)
+    return {
+        "metric": f"multichip_serving_scaling_{args.model}",
+        "unit": "tok/s",
+        "backend": args.backend,
+        "model": args.model,
+        "workload": {
+            "users": args.users,
+            "rounds": args.rounds,
+            "history_tokens_per_user": args.history_tokens,
+            "max_model_len": args.max_model_len,
+            "max_tokens": args.max_tokens,
+            "kv_cache_dtype": args.kv_cache_dtype,
+        },
+        "curve": curve,
+        "zero_5xx": True,
+        "serving": True,   # real bench harness, not a dryrun parity check
+        "runs": runs,
     }
 
 
@@ -571,6 +683,26 @@ def main():
                          "prefix-aware also launches a shared cache "
                          "server and wires --prefix-tokenizer/"
                          "--kv-offload-url, docs/KV_ECONOMY.md)")
+    ap.add_argument("--tensor-parallel-size", type=int, default=1,
+                    help="boot every engine on a tp-sharded device mesh "
+                         "(docs/PERF.md round 9): the KV pool, int8 scale "
+                         "sidecars, and paged-attention kernel shard the "
+                         "kv-head axis over tp devices. On CPU the bench "
+                         "forces a virtual 8-device platform into the "
+                         "engine subprocesses; on a TPU slice the real "
+                         "chips serve the same code path. The roofline "
+                         "and hbm_bw_pct scale by the chip count")
+    ap.add_argument("--multichip-sweep", default=None,
+                    help="comma-separated chip counts (e.g. 1,2,4,8): run "
+                         "the stack bench once per tp point on the same "
+                         "workload and print one scaling-curve report "
+                         "(tok/s + tok/s-per-chip + scaling efficiency "
+                         "per point, zero-5xx bar enforced) — the "
+                         "MULTICHIP_r*.json serving artifact "
+                         "tools/capacity.py consumes")
+    ap.add_argument("--multichip-output", default=None,
+                    help="also write the --multichip-sweep report JSON "
+                         "here (e.g. MULTICHIP_r06.json)")
     ap.add_argument("--num-engines", type=int, default=1,
                     help="engine subprocesses behind the router; 2-process "
                          "smoke: --model facebook/opt-125m --num-engines 2 "
@@ -659,6 +791,7 @@ def main():
 
         if args.num_engines < 2:
             args.num_engines = 2   # chaos needs a peer to fail over to
+        _force_virtual_devices(args, args.tensor_parallel_size)
         report = run_soak(args)
         print(json.dumps(report))
         if args.soak_output:
@@ -671,6 +804,17 @@ def main():
         )
         return 0
 
+    if args.multichip_sweep:
+        args.mode = "stack"  # the scaling curve is a stack-shape run
+        report = bench_multichip_sweep(args)
+        print(json.dumps(report))
+        if args.multichip_output:
+            with open(args.multichip_output, "w") as f:
+                json.dump(report, f, indent=1)
+                f.write("\n")
+        return 0
+
+    _force_virtual_devices(args, args.tensor_parallel_size)
     if args.disagg:
         args.mode = "stack"  # disagg is a stack-shape run (JSON line parity)
         res = bench_disagg(args)
@@ -678,6 +822,16 @@ def main():
         res = bench_stack(args)
     else:
         res = bench_engine(args)
+    out = _result_line(args, res)
+    print(json.dumps(out))
+    return 0
+
+
+def _result_line(args, res) -> dict:
+    """The one-line JSON benchmark record from a mode runner's result:
+    roofline accounting (per-chip honest at tp>1), kv-hit, speculative and
+    multichip fields. Shared by the single-shot modes and every
+    --multichip-sweep point."""
     summary = res["summary"]
 
     from production_stack_tpu.engine.config import EngineConfig
@@ -695,9 +849,17 @@ def main():
             spec.get("spec_acceptance_rate", 0.0)
             * args.speculative_num_tokens
         )
+    # Total chips across the deployment: tp devices per engine mesh x the
+    # engine replica count (the disagg shape is a fixed 1-prefill +
+    # 1-decode pair). Per-chip goodput and the chip-scaled roofline must
+    # count BOTH axes or a --num-engines run overstates itself.
+    tp = max(1, getattr(args, "tensor_parallel_size", 1))
+    engines = 2 if getattr(args, "disagg", False) \
+        else max(1, getattr(args, "num_engines", 1))
+    num_chips = tp * engines
     comp = roofline_components(
         args.model, dtype_bytes, args.kv_cache_dtype, max(1, args.users),
-        avg_ctx, tokens_per_target_step=eff_tokens,
+        avg_ctx, tokens_per_target_step=eff_tokens, num_chips=num_chips,
     )
     roofline = comp["roofline_tok_s"]
     out = {
@@ -714,15 +876,25 @@ def main():
         "roofline_kv_bytes_per_token": comp["kv_bytes_per_token"],
         "roofline_kv_bytes_per_step_per_row":
             round(comp["kv_bytes_per_step_per_row"]),
+        # Multi-chip serving (docs/PERF.md round 9): ONE engine's mesh
+        # shape, the engine replica count, the aggregate-vs-per-chip
+        # split (the scaling curve's y axes), and the roofline's chip
+        # scaling already applied above.
+        "mesh_shape": {"dp": 1, "sp": 1, "tp": tp},
+        "num_engines": engines,
+        "num_chips": num_chips,
+        "tok_per_s_per_chip": round(res["value"] / num_chips, 2),
         "p50_ttft_s": round(summary["p50_ttft_s"], 4)
         if summary.get("p50_ttft_s") else None,
         "total_output_tokens": summary["total_output_tokens"],
+        "finished_requests": summary.get("finished_requests", 0),
+        "errors_total": summary.get("errors_total", 0),
         # BASELINE target #3 (KV-hit parity): prefix-cache hit fraction of
         # queried tokens over the timed region, under the long-history
         # multi-round workload (--history-tokens).
         "kv_hit_rate": res.get("kv_hit_rate"),
         "history_tokens_per_user": args.history_tokens,
-        "backend": backend,
+        "backend": args.backend,
         # Speculative decoding (docs/PERF.md round 8): acceptance-rate
         # telemetry + the effective-tokens factor the roofline above used.
         "spec_enabled": int(bool(spec.get("spec_enabled", 0))),
@@ -741,8 +913,7 @@ def main():
         })
     if "disagg" in res:
         out["disagg"] = res["disagg"]
-    print(json.dumps(out))
-    return 0
+    return out
 
 
 if __name__ == "__main__":

@@ -767,6 +767,10 @@ def run_soak(args) -> dict:
                 "--breaker-half-open-dwell", "2.0",
             ],
             num_engines=args.num_engines,
+            # Multi-chip soak (docs/PERF.md round 9): every engine on a
+            # tp mesh — bench.py forces the virtual device platform on
+            # CPU before this runs.
+            tensor_parallel_size=getattr(args, "tensor_parallel_size", 1),
         )
         # Warmup: compile every measured shape before the ladder starts
         # (BENCH_r04's cold-compile lesson).

@@ -236,6 +236,7 @@ def launch_stack(
     num_engines: int = 1,
     per_engine_args: Optional[List[List[str]]] = None,
     engine_env: Optional[dict] = None,
+    tensor_parallel_size: int = 1,
 ) -> StackHandle:
     """Start ``num_engines`` engine pods + the router; block until all are
     healthy. Multiple engines make the load-balancing routing logics
@@ -243,7 +244,22 @@ def launch_stack(
     opt-125m smoke path in the benchmark sweep. ``per_engine_args[i]`` are
     appended to engine i's argv (role-split disagg pools) and
     ``engine_env`` entries override the inherited environment (e.g.
-    LMCACHE_REMOTE_URL for the shared offload store)."""
+    LMCACHE_REMOTE_URL for the shared offload store).
+
+    ``tensor_parallel_size`` > 1 boots every engine on a tp-sharded device
+    mesh (threaded through per_engine_args, so a caller's own per-engine
+    extras can still override it per pod). On CPU the caller must also put
+    ``--xla_force_host_platform_device_count=N`` into the subprocesses'
+    XLA_FLAGS (bench.py does; the same code path IS the TPU slice path,
+    where the real devices are just present)."""
+    if tensor_parallel_size > 1:
+        pea = [list(a) for a in (per_engine_args or [])]
+        while len(pea) < max(1, num_engines):
+            pea.append([])
+        per_engine_args = [
+            ["--tensor-parallel-size", str(tensor_parallel_size), *a]
+            for a in pea
+        ]
     router_port = free_port()
     router_url = f"http://127.0.0.1:{router_port}"
     served = served_model or model

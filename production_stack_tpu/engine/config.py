@@ -187,8 +187,65 @@ class EngineConfig:
         # Speculative decoding is validated at CONFIG PARSE TIME so a
         # mis-paired draft is a clean startup error, not a mid-scan shape
         # crash (docs/PERF.md round 8).
+        # Multi-chip combos are validated at parse time too: a tp that
+        # can't shard the scale pools, or spec-decoding on a mesh, must be
+        # a clean config error at startup, never a sharded-dispatch shape
+        # crash minutes into serving (docs/PERF.md round 9). Runs before
+        # the draft resolution so the spec+tp pairing gets the error that
+        # names both flags.
+        self.validate_parallelism()
         if self.speculative_num_tokens:
             self.resolved_draft_config()
+
+    @property
+    def mesh_devices(self) -> int:
+        """Devices the serving mesh occupies (dp x sp x tp)."""
+        return (self.data_parallel_size * self.sequence_parallel_size
+                * self.tensor_parallel_size)
+
+    def validate_parallelism(self) -> None:
+        """Parse-time validation of the parallelism axes against the other
+        knobs. Raises ValueError naming the exact flag pair at fault."""
+        tp = self.tensor_parallel_size
+        sp = self.sequence_parallel_size
+        if tp < 1 or sp < 1 or self.data_parallel_size < 1:
+            raise ValueError(
+                "--tensor-parallel-size/--sequence-parallel-size/"
+                "--data-parallel-size must all be >= 1, got "
+                f"tp={tp} sp={sp} dp={self.data_parallel_size}"
+            )
+        if self.speculative_num_tokens and (tp > 1 or sp > 1):
+            raise ValueError(
+                "--speculative-num-tokens is incompatible with "
+                "--tensor-parallel-size/--sequence-parallel-size > 1: "
+                "speculative decoding currently requires a single-device "
+                "mesh (tp=sp=1) — the draft-KV ring pools and the batched "
+                "verify chunk are not mesh-sharded yet. Drop the "
+                "speculative flags to serve on the mesh, or serve "
+                "speculatively on one chip."
+            )
+        if tp > 1 and self.kv_cache_quantized:
+            # The int8 scale sidecars [L, Hkv, slots] shard the kv-head
+            # axis exactly like the payload pools (parallel/sharding.py:
+            # kv_scale_sharding); an indivisible head count would silently
+            # fall back to REPLICATED scale pools against SHARDED int8
+            # payloads on the Pallas shard_map path. Assert the same
+            # divisibility the head counts get, at parse time.
+            from production_stack_tpu.models.config import (
+                resolve_model_config,
+            )
+
+            mc = resolve_model_config(self.model)
+            if mc.num_kv_heads % tp or mc.num_heads % tp:
+                raise ValueError(
+                    f"--kv-cache-dtype int8 with --tensor-parallel-size "
+                    f"{tp} requires tp to divide the model's head counts "
+                    f"(the per-(slot, head) scale pools are kv-head-"
+                    f"sharded over the tp axis like the payload pools); "
+                    f"model {self.model!r} has "
+                    f"{mc.num_heads}/{mc.num_kv_heads} heads. Use a tp "
+                    f"that divides both, or --kv-cache-dtype bfloat16."
+                )
 
     @property
     def speculative_enabled(self) -> bool:
@@ -220,10 +277,14 @@ class EngineConfig:
                 "the compute dtype)"
             )
         if self.tensor_parallel_size > 1 or self.sequence_parallel_size > 1:
+            # Kept for direct resolved_draft_config() callers; __post_init__
+            # raises the same restriction from validate_parallelism first.
             raise ValueError(
-                "speculative decoding currently requires "
-                "tensor_parallel_size == sequence_parallel_size == 1 "
-                "(the draft ring and verify chunk are not mesh-sharded yet)"
+                "--speculative-num-tokens is incompatible with "
+                "--tensor-parallel-size/--sequence-parallel-size > 1: "
+                "speculative decoding currently requires a single-device "
+                "mesh (tp=sp=1) — the draft-KV ring pools and the batched "
+                "verify chunk are not mesh-sharded yet"
             )
         target = resolve_model_config(self.model)
         draft = resolve_model_config(self.speculative_model)

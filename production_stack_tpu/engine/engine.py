@@ -963,6 +963,15 @@ class ServingEngine:
             "kv_num_blocks": self.runner.num_kv_blocks,
             "kv_quant_bytes_saved_total":
                 self.runner.kv_quant_bytes_saved_total,
+            # Multi-chip serving (docs/PERF.md round 9): the mesh this
+            # engine's dispatches shard over (the LIVE mesh — an explicit
+            # mesh= override wins over the config axes), plus the KV
+            # pool's actual per-device HBM footprint (payload + scale
+            # sidecars).
+            "mesh_tp_size": self.mesh.shape.get("tp", 1),
+            "mesh_sp_size": self.mesh.shape.get("sp", 1),
+            "mesh_devices": self.mesh.size,
+            "hbm_kv_bytes_per_device": self.runner.per_device_hbm_kv_bytes(),
             "num_requests_running": self.scheduler.num_running,
             "num_requests_waiting": self.scheduler.num_waiting,
             # Autoscaling signal (docs/SOAK.md): total backlog on this

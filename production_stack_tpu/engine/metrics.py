@@ -162,6 +162,28 @@ class EngineMetricsCollector(Collector):
             "compute dtype",
             getattr(eng.runner, "kv_quant_bytes_saved_total", 0),
         )
+        # Multi-chip serving (docs/PERF.md round 9): mesh shape + per-device
+        # KV-pool residency — the text renderer exports the same series.
+        mesh_shape = getattr(getattr(eng, "mesh", None), "shape", {})
+        yield gauge("pstpu:mesh_tp_size",
+                    "Tensor-parallel degree of the serving mesh",
+                    mesh_shape.get("tp", 1))
+        yield gauge("pstpu:mesh_sp_size",
+                    "Sequence-parallel degree of the serving mesh",
+                    mesh_shape.get("sp", 1))
+        yield gauge("pstpu:mesh_devices",
+                    "Devices the serving mesh occupies (dp x sp x tp)",
+                    getattr(getattr(eng, "mesh", None), "size", 1))
+        hbm_g = GaugeMetricFamily(
+            "pstpu:hbm_kv_bytes",
+            "KV-pool bytes resident per mesh device (payload + scale "
+            "sidecars; kv-head-sharded at tp>1)",
+            labels=["model_name", "device"],
+        )
+        per_dev = getattr(runner, "per_device_hbm_kv_bytes", dict)()
+        for dev, b in sorted(per_dev.items()):
+            hbm_g.add_metric([eng.config.model_name, dev], b)
+        yield hbm_g
         disagg = getattr(eng, "disagg", None)
         d = disagg.stats() if disagg is not None else {}
         yield counter("pstpu:kv_handoffs_total",

@@ -568,6 +568,29 @@ class ModelRunner:
             return 0.0
         return self.spec_accepted_tokens_total / self.spec_draft_tokens_total
 
+    def per_device_hbm_kv_bytes(self) -> Dict[str, int]:
+        """Actual device bytes the KV pool (payload + scale sidecars)
+        occupies on EACH mesh device, keyed "platform:id" — the
+        pstpu:hbm_kv_bytes{device} gauge. With tp>1 the pools are kv-head-
+        sharded, so each device holds ~1/tp of kv_pool_bytes; a replicated
+        fallback (indivisible heads) is immediately visible as every
+        device holding the full pool. Probed from the live arrays'
+        addressable shards; a dispatch may have donated the pool buffers
+        mid-probe, in which case the last good snapshot is returned."""
+        out: Dict[str, int] = {}
+        try:
+            pools = [self.kv_k, self.kv_v]
+            if self.kv_quantized:
+                pools += [self.kv_k_scale, self.kv_v_scale]
+            for pool in pools:
+                for sh in pool.addressable_shards:
+                    dev = f"{sh.device.platform}:{sh.device.id}"
+                    out[dev] = out.get(dev, 0) + int(sh.data.nbytes)
+        except Exception:  # noqa: BLE001 — donated mid-step; keep last
+            return getattr(self, "_last_device_kv_bytes", {})
+        self._last_device_kv_bytes = out
+        return out
+
     @property
     def kv_pool_bytes(self) -> int:
         """Derived device bytes of the KV pool (payload + scale sidecars) —

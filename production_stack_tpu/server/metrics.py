@@ -32,6 +32,10 @@ def render_engine_metrics(engine: "ServingEngine", model_name: str) -> str:
         s.setdefault(key, 0)
     s.setdefault("disagg_role", "unified")
     s.setdefault("kv_cache_dtype", "bfloat16")
+    s.setdefault("mesh_tp_size", 1)
+    s.setdefault("mesh_sp_size", 1)
+    s.setdefault("mesh_devices", 1)
+    s.setdefault("hbm_kv_bytes_per_device", {})
     label = f'{{model_name="{model_name}"}}'
     lines = [
         "# HELP vllm:num_requests_running Running requests",
@@ -194,6 +198,29 @@ def render_engine_metrics(engine: "ServingEngine", model_name: str) -> str:
         "# TYPE pstpu:kv_quant_bytes_saved_total counter",
         f"pstpu:kv_quant_bytes_saved_total{label} "
         f"{s['kv_quant_bytes_saved_total']}",
+        # Multi-chip serving (docs/PERF.md round 9): the mesh shape the
+        # engine's dispatches shard over (the collector renders the same
+        # series — PL004 keeps them aligned).
+        "# HELP pstpu:mesh_tp_size Tensor-parallel degree of the serving "
+        "mesh",
+        "# TYPE pstpu:mesh_tp_size gauge",
+        f"pstpu:mesh_tp_size{label} {s['mesh_tp_size']}",
+        "# HELP pstpu:mesh_sp_size Sequence-parallel degree of the serving "
+        "mesh",
+        "# TYPE pstpu:mesh_sp_size gauge",
+        f"pstpu:mesh_sp_size{label} {s['mesh_sp_size']}",
+        "# HELP pstpu:mesh_devices Devices the serving mesh occupies "
+        "(dp x sp x tp)",
+        "# TYPE pstpu:mesh_devices gauge",
+        f"pstpu:mesh_devices{label} {s['mesh_devices']}",
+        "# HELP pstpu:hbm_kv_bytes KV-pool bytes resident per mesh device "
+        "(payload + scale sidecars; kv-head-sharded at tp>1)",
+        "# TYPE pstpu:hbm_kv_bytes gauge",
+        *[
+            f'pstpu:hbm_kv_bytes{{model_name="{model_name}",'
+            f'device="{dev}"}} {b}'
+            for dev, b in sorted(s["hbm_kv_bytes_per_device"].items())
+        ],
     ]
     # TTFT / e2e latency distributions (the reference dashboard's two
     # distribution panels query these bucket series).

@@ -154,6 +154,50 @@ def test_speculative_tpu_config_renders_engine_flags():
     jsonschema.validate(values, schema)
 
 
+def test_tensor_parallel_tpu_config_renders_engine_flag():
+    """tpuConfig.tensorParallelSize renders --tensor-parallel-size on the
+    engine container (docs/PERF.md round 9), the result parses with the
+    real engine CLI parser, and the knob satisfies the published schema."""
+    values = {
+        "servingEngineSpec": {
+            "runtimeClassName": "",
+            "modelSpec": [{
+                "name": "multichip",
+                "repository": "production-stack-tpu/engine",
+                "tag": "latest",
+                "modelURL": "llama-1b",
+                "replicaCount": 1,
+                "requestCPU": 4,
+                "requestMemory": "16Gi",
+                "requestGPU": 4,
+                "tpuConfig": {
+                    "tensorParallelSize": 4,
+                    "kvCacheDtype": "bfloat16",
+                },
+            }],
+        },
+    }
+    manifests = render_chart(CHART, values=values, release_name="stack")
+    engine = next(
+        m for m in _by_kind(manifests, "Deployment")
+        if m["metadata"]["name"].endswith("deployment-engine")
+    )
+    args = [str(a) for a in _container(engine, "engine")["args"]]
+    assert args[args.index("--tensor-parallel-size") + 1] == "4"
+    from production_stack_tpu.server.api_server import (
+        parse_args as engine_parse_args,
+    )
+
+    ns = engine_parse_args(args)
+    assert ns.tensor_parallel_size == 4
+    jsonschema = pytest.importorskip("jsonschema")
+    import json
+
+    with open(os.path.join(CHART, "values.schema.json")) as f:
+        schema = json.load(f)
+    jsonschema.validate(values, schema)
+
+
 def test_lmcache_env_contract():
     manifests = render_chart(CHART, values_file=EXAMPLES[3],  # values-06
                              release_name="stack")

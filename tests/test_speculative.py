@@ -58,7 +58,7 @@ def test_spec_rejects_int8_kv_cache():
 def test_spec_rejects_tensor_parallel():
     cfg = dict(BASE)
     cfg["tensor_parallel_size"] = 2
-    with pytest.raises(ValueError, match="tensor_parallel"):
+    with pytest.raises(ValueError, match="tensor-parallel-size"):
         EngineConfig(**cfg, speculative_num_tokens=3,
                      speculative_model="tiny-llama")
 

@@ -1,0 +1,236 @@
+"""chips -> QPS capacity model from a measured multichip serving curve.
+
+Input: a MULTICHIP_r*.json report recorded by ``bench.py --multichip-sweep``
+(docs/PERF.md round 9) — REAL serving numbers from the stack harness
+(router + engine subprocesses, zero-5xx enforced per point), never a dryrun
+parity check. The model turns that curve into the numbers an operator (or
+the Helm HPA stanzas) can actually provision against:
+
+    QPS(chips) = per_chip_goodput x chips x scaling_efficiency(chips)
+                 x slo_headroom / tokens_per_request
+
+  * per_chip_goodput      — measured tok/s per chip at the 1-chip point;
+  * scaling_efficiency    — measured tok/s-per-chip at n chips relative to
+                            the 1-chip point (collectives + sharding
+                            overhead make it <= 1);
+  * slo_headroom          — fraction of raw throughput to provision at so
+                            the PR-7 SLO attainment bars hold under the
+                            arrival jitter a soak actually sees (the soak
+                            recovery threshold, 0.9, is the default: a
+                            fleet run at its exact roofline has zero slack
+                            for a single fault);
+  * tokens_per_request    — output tokens per finished request from the
+                            same workload.
+
+Beyond the largest measured mesh the fleet composes as DP replicas behind
+the prefix-aware router (ROADMAP: router-level DP), so capacity scales
+linearly in ENGINES of the best measured mesh shape — and the model emits
+the concrete HPA targets for the exported autoscaling signals
+(docs/SOAK.md): the per-engine ``pstpu:queue_depth`` average target
+(Little's law: the concurrency one engine sustains at its SLO-headroom
+QPS) and the router-level ``router_queue_depth`` sum at each fleet size.
+
+CLI:
+    python -m tools.capacity MULTICHIP_r06.json [--target-qps N]
+        [--slo-headroom 0.9] [--max-engines 8] [--json]
+"""
+
+import argparse
+import json
+import math
+import sys
+from typing import Dict, List, Optional
+
+
+def _tokens_per_request(report: dict) -> float:
+    """Mean output tokens per finished request across the sweep points."""
+    toks = reqs = 0
+    for run in report.get("runs", []):
+        toks += run.get("total_output_tokens", 0)
+        reqs += run.get("finished_requests", 0)
+    if reqs:
+        return toks / reqs
+    # Fall back to the workload's nominal answer size.
+    return float(report.get("workload", {}).get("max_tokens", 100))
+
+
+def _avg_latency_s(report: dict) -> float:
+    """Mean request latency over the sweep (Little's law on the measured
+    closed loop: users concurrent sessions finishing at the measured QPS)."""
+    users = report.get("workload", {}).get("users", 1)
+    lats = [
+        users / run["qps"]
+        for run in report.get("runs", [])
+        if run.get("qps")
+    ]
+    return sum(lats) / len(lats) if lats else 1.0
+
+
+def capacity_model(
+    report: dict,
+    slo_headroom: float = 0.9,
+    max_engines: int = 8,
+) -> dict:
+    """Pure function: multichip sweep report -> chips->QPS capacity table
+    + HPA targets. See the module docstring for the math."""
+    curve = report.get("curve") or []
+    if not curve:
+        raise ValueError("report carries no multichip curve")
+    if not 0.0 < slo_headroom <= 1.0:
+        raise ValueError(f"slo_headroom must be in (0, 1], got {slo_headroom}")
+    base = curve[0]
+    per_chip_goodput = base["tok_s"] / base["chips"]
+    tokens_per_request = _tokens_per_request(report)
+    avg_latency_s = _avg_latency_s(report)
+
+    rows: List[Dict] = []
+    # Measured mesh points: one engine, n chips.
+    for pt in curve:
+        qps_cap = (
+            pt["tok_s"] * slo_headroom / tokens_per_request
+        )
+        rows.append({
+            "chips": pt["chips"],
+            "engines": 1,
+            "chips_per_engine": pt["chips"],
+            "tok_s": pt["tok_s"],
+            "scaling_efficiency": pt.get(
+                "scaling_efficiency",
+                round((pt["tok_s"] / pt["chips"]) / per_chip_goodput, 4),
+            ),
+            "qps_capacity": round(qps_cap, 3),
+            "measured": True,
+        })
+    # DP-replica extrapolation beyond the largest measured mesh: replicas
+    # of the most tok/s-per-chip-efficient measured shape behind the
+    # router. Linear in engines — each replica is an independent mesh; the
+    # router's prefix-aware balancing is what makes the composition hold.
+    best = max(curve, key=lambda p: p["tok_s"] / p["chips"])
+    best_qps = best["tok_s"] * slo_headroom / tokens_per_request
+    for engines in range(2, max(2, max_engines) + 1):
+        rows.append({
+            "chips": engines * best["chips"],
+            "engines": engines,
+            "chips_per_engine": best["chips"],
+            "tok_s": round(engines * best["tok_s"], 2),
+            "scaling_efficiency": best.get("scaling_efficiency", 1.0),
+            "qps_capacity": round(engines * best_qps, 3),
+            "measured": False,
+        })
+    rows.sort(key=lambda r: (r["chips"], r["engines"]))
+
+    # HPA targets (docs/SOAK.md signals): the per-engine queue depth one
+    # engine of the best shape sustains at its headroom QPS — requests in
+    # flight = QPS x latency (Little) — and the router-level sum at each
+    # fleet size. Floored at 1: a target of 0 would scale the fleet on
+    # every single queued request.
+    engine_queue_target = max(1, math.floor(best_qps * avg_latency_s))
+    return {
+        "model": report.get("model"),
+        "backend": report.get("backend"),
+        "slo_headroom": slo_headroom,
+        "per_chip_goodput_tok_s": round(per_chip_goodput, 2),
+        "tokens_per_request": round(tokens_per_request, 2),
+        "avg_request_latency_s": round(avg_latency_s, 3),
+        "best_mesh_chips": best["chips"],
+        "table": rows,
+        "hpa_targets": {
+            # servingEngineSpec.autoscaling targetValue for the Pods
+            # metric pstpu_queue_depth (helm/values-07-autoscaling).
+            "pstpu_queue_depth_per_engine": engine_queue_target,
+            # routerSpec.autoscaling Object metric router_queue_depth:
+            # the fleet-wide backlog sum at which one MORE engine of the
+            # best shape is warranted.
+            "router_queue_depth_per_engine": engine_queue_target,
+        },
+    }
+
+
+def engines_for_qps(model: dict, target_qps: float) -> dict:
+    """Smallest fleet (engines of the best measured mesh shape) whose
+    capacity covers ``target_qps``, with the HPA budget it implies."""
+    per_engine = next(
+        (r["qps_capacity"] for r in model["table"]
+         if r["engines"] == 1 and r["chips"] == model["best_mesh_chips"]),
+        None,
+    )
+    if not per_engine:
+        raise ValueError("model has no per-engine capacity row")
+    engines = max(1, math.ceil(target_qps / per_engine))
+    return {
+        "target_qps": target_qps,
+        "engines": engines,
+        "chips": engines * model["best_mesh_chips"],
+        "qps_capacity": round(engines * per_engine, 3),
+        "router_queue_depth_scale_out_above": engines * model[
+            "hpa_targets"
+        ]["router_queue_depth_per_engine"],
+    }
+
+
+def _render_table(model: dict) -> str:
+    lines = [
+        f"chips -> QPS capacity ({model['model']}, "
+        f"headroom {model['slo_headroom']}, "
+        f"{model['tokens_per_request']:.0f} tok/req, "
+        f"per-chip goodput {model['per_chip_goodput_tok_s']} tok/s)",
+        f"{'chips':>6} {'engines':>8} {'tok/s':>10} {'eff':>6} "
+        f"{'QPS':>9}  source",
+    ]
+    for r in model["table"]:
+        lines.append(
+            f"{r['chips']:>6} {r['engines']:>8} {r['tok_s']:>10.1f} "
+            f"{r['scaling_efficiency']:>6.2f} {r['qps_capacity']:>9.2f}  "
+            f"{'measured' if r['measured'] else 'dp-extrapolated'}"
+        )
+    hpa = model["hpa_targets"]
+    lines.append(
+        f"HPA: pstpu_queue_depth per-engine target "
+        f"{hpa['pstpu_queue_depth_per_engine']}; scale out when the "
+        f"router_queue_depth sum exceeds "
+        f"{hpa['router_queue_depth_per_engine']} x engines"
+    )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="chips->QPS capacity model from a MULTICHIP_r*.json "
+                    "serving scaling curve (bench.py --multichip-sweep)"
+    )
+    ap.add_argument("report", help="MULTICHIP_r*.json path")
+    ap.add_argument("--slo-headroom", type=float, default=0.9,
+                    help="fraction of raw throughput to provision at "
+                         "(default 0.9 — the soak recovery attainment "
+                         "threshold, docs/SOAK.md)")
+    ap.add_argument("--max-engines", type=int, default=8,
+                    help="DP-replica rows to extrapolate beyond the "
+                         "largest measured mesh")
+    ap.add_argument("--target-qps", type=float, default=None,
+                    help="also print the smallest fleet covering this QPS")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the model as JSON instead of the table")
+    args = ap.parse_args(argv)
+
+    with open(args.report) as f:
+        report = json.load(f)
+    model = capacity_model(
+        report, slo_headroom=args.slo_headroom, max_engines=args.max_engines
+    )
+    if args.target_qps is not None:
+        model["provision"] = engines_for_qps(model, args.target_qps)
+    if args.json:
+        print(json.dumps(model, indent=1))
+    else:
+        print(_render_table(model))
+        if "provision" in model:
+            p = model["provision"]
+            print(
+                f"target {p['target_qps']} QPS -> {p['engines']} engines "
+                f"({p['chips']} chips), capacity {p['qps_capacity']} QPS"
+            )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,6 +34,7 @@ TABLES = {
     "autoscaling": "docs/SOAK.md",
     "kv-economy": "docs/KV_ECONOMY.md",
     "speculative": "docs/PERF.md",
+    "multichip": "docs/PERF.md",
 }
 
 FLAG_TABLES = {

@@ -135,6 +135,20 @@ REGISTRY: Tuple[Series, ...] = (
     Series("pstpu:kv_chain_evictions_total", "counter", ("model_name",),
            _BOTH_ENGINE, ("catalogue", "kv-economy"),
            "Leaf-first chain evictions in the local host KV tier"),
+    # --------------------------------------------- engine: multichip
+    Series("pstpu:mesh_tp_size", "gauge", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "multichip"),
+           "Tensor-parallel degree of the serving mesh"),
+    Series("pstpu:mesh_sp_size", "gauge", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "multichip"),
+           "Sequence-parallel degree of the serving mesh"),
+    Series("pstpu:mesh_devices", "gauge", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "multichip"),
+           "Devices the serving mesh occupies (dp x sp x tp)"),
+    Series("pstpu:hbm_kv_bytes", "gauge", ("model_name", "device"),
+           _BOTH_ENGINE, ("catalogue", "multichip"),
+           "KV-pool bytes resident per mesh device (payload + scale "
+           "sidecars; kv-head-sharded at tp>1)"),
     # --------------------------------------------- engine: speculative
     Series("pstpu:spec_enabled", "gauge", ("model_name",),
            _BOTH_ENGINE, ("catalogue", "speculative"),
