@@ -216,11 +216,18 @@ def bench_stack(args) -> dict:
         ]
     stack = launch_stack(
         args.model,
+        # Elastic fast-start (docs/ELASTIC.md): a shared persistent
+        # compile-cache dir makes the cold-vs-warm boot A/B two recorded
+        # bench lines (engine_ready_seconds + startup_cache_*_families).
+        compilation_cache_dir=getattr(args, "compilation_cache_dir", None),
         engine_args=[
             "--max-model-len", str(args.max_model_len),
             "--max-num-seqs", str(max(8, args.users)),
             "--attn-impl", args.attn_impl,
             "--kv-cache-dtype", args.kv_cache_dtype,
+            *(["--max-num-batched-tokens",
+               str(args.max_num_batched_tokens)]
+              if getattr(args, "max_num_batched_tokens", None) else []),
             *(["--no-warmup"]
               if getattr(args, "no_engine_warmup", False) else []),
             *(["--decode-loop", args.decode_loop]
@@ -269,6 +276,14 @@ def bench_stack(args) -> dict:
         records = asyncio.run(run_workload(cfg))
         h1, q1 = _scrape_prefix_counters(stack.engine_urls)
         spec = _scrape_spec_metrics(stack.engine_urls)
+        from benchmarks.soak import engine_startup_stats
+
+        startup = [engine_startup_stats(u) for u in stack.engine_urls]
+        # getattr: test harnesses substitute minimal stack fakes.
+        ready_seconds = [
+            round(s, 3)
+            for s in getattr(stack, "engine_ready_seconds", [])
+        ]
     finally:
         stack.terminate()
         if kv_proc is not None and kv_proc.poll() is None:
@@ -295,6 +310,11 @@ def bench_stack(args) -> dict:
         "avg_prompt_tokens": avg_prompt,
         "kv_hit_rate": round((h1 - h0) / max(1.0, q1 - q0), 4),
         "spec": spec,
+        # Elastic fast-start (docs/ELASTIC.md): per-engine process spawn
+        # -> /health-serving seconds + each engine's startup-phase /
+        # compile-cache telemetry — the cold-vs-warm A/B's recorded form.
+        "engine_ready_seconds": ready_seconds,
+        "engine_startup": startup,
     }
 
 
@@ -658,6 +678,12 @@ def main():
     # window-copy memory wall (paged decode; bucketed window for head_dim<128
     # models) — VERDICT r2 weak #2 demanded the bench stop pinning 1024.
     ap.add_argument("--max-model-len", type=int, default=8192)
+    ap.add_argument("--max-num-batched-tokens", type=int, default=None,
+                    help="engine --max-num-batched-tokens passthrough "
+                         "(prefill chunk budget; also bounds the warmup "
+                         "prefill-family t buckets — the cold/warm boot "
+                         "A/B uses a small value so startup is "
+                         "compile-dominated, docs/ELASTIC.md)")
     ap.add_argument("--decode-loop", default=None,
                     choices=["while", "scan"],
                     help="A/B the fused-decode loop construct")
@@ -767,6 +793,35 @@ def main():
     ap.add_argument("--soak-output", default=None,
                     help="write the soak report JSON here (e.g. "
                          "BENCH_soak_r01.json) in addition to stdout")
+    # Elastic fast-start (docs/ELASTIC.md): the scale_out_engine /
+    # scale_in_engine fault actions plus the knobs that make a joining
+    # engine useful fast — a shared compile cache, router-driven prefix
+    # prewarm, and slow-start ramp-in.
+    ap.add_argument("--compilation-cache-dir", default=None,
+                    help="shared persistent XLA compile-cache dir for "
+                         "every engine subprocess (docs/ELASTIC.md): run "
+                         "the bench twice on one dir for the recorded "
+                         "cold-vs-warm boot A/B (engine_ready_seconds + "
+                         "startup_cache_*_families in the JSON line)")
+    ap.add_argument("--soak-routing-logic", default="session",
+                    choices=["roundrobin", "session",
+                             "cache_aware_load_balancing", "prefix-aware"],
+                    help="router routing logic for the soak stack "
+                         "(cache_aware/prefix-aware score load, so the "
+                         "--soak-ramp-in slow-start applies to them)")
+    ap.add_argument("--soak-prewarm-top-k", type=int, default=0,
+                    help="router --prewarm-top-k for the soak: POST "
+                         "/prewarm to a scaled-out engine before it takes "
+                         "load (0 disables; docs/ELASTIC.md)")
+    ap.add_argument("--soak-ramp-in", type=float, default=0.0,
+                    help="router --ramp-in-seconds for the soak: "
+                         "slow-start window for the joining engine")
+    ap.add_argument("--soak-elastic-ab", action="store_true",
+                    help="run the ladder twice (prewarm/ramp on, then "
+                         "off against a fresh stack) and embed the "
+                         "control's elastic measurements in the report — "
+                         "the prewarmed-vs-control first-minute "
+                         "kv_hit_rate A/B as one artifact")
     args = ap.parse_args()
     for attr in ("soak_fault_schedule", "soak_classes"):
         val = getattr(args, attr)
@@ -910,6 +965,26 @@ def _result_line(args, res) -> dict:
             "qps": round(summary["qps"], 3),
             "input_tok_s": round(summary["input_tokens_per_s"], 1),
             "avg_ttft_s": round(summary["avg_ttft_s"], 4),
+        })
+    if "engine_ready_seconds" in res:
+        # Elastic fast-start A/B record (docs/ELASTIC.md): spawn ->
+        # /health per engine plus the warmup compile-cache hit/miss split
+        # (warm boot: hits > 0, misses == 0 for an unchanged config).
+        startup = res.get("engine_startup") or []
+        out.update({
+            "engine_ready_seconds": res["engine_ready_seconds"],
+            "compilation_cache_dir": getattr(
+                args, "compilation_cache_dir", None
+            ),
+            "startup_cache_hit_families": sum(
+                int(s.get("startup_cache_hit_families", 0))
+                for s in startup
+            ),
+            "startup_cache_miss_families": sum(
+                int(s.get("startup_cache_miss_families", 0))
+                for s in startup
+            ),
+            "engine_startup": startup,
         })
     if "disagg" in res:
         out["disagg"] = res["disagg"]

@@ -39,9 +39,15 @@ SCHEMA = "pstpu-soak-v1"
 #: drain — in-flight streams die mid-byte, the fault class the router's
 #: mid-stream resume (docs/RESILIENCE.md) must absorb for the
 #: zero-truncation bar to hold.
+#: ``scale_out_engine`` / ``scale_in_engine`` are the local HPA emulation
+#: (docs/ELASTIC.md): scale-out spawns a new engine subprocess (recording
+#: the router_queue_depth that triggered it, its spawn->/health time, and
+#: its time-to-first-SLO-met-token), scale-in drains one out with the
+#: zero-5xx bar still applying. Both require the stack to run a
+#: dynamic-config-backed router (bench.py --soak does).
 FAULT_ACTIONS = (
     "restart_engine", "restart_kv_server", "degrade_engine", "heal_engine",
-    "kill_engine",
+    "kill_engine", "scale_out_engine", "scale_in_engine",
 )
 
 #: Router gauges the autoscaler wiring targets (docs/SOAK.md); the soak
@@ -316,10 +322,14 @@ def build_report(*, model: str, backend: str, num_engines: int,
                  slo_attainment_gauge: Optional[Dict[str, float]] = None,
                  faults_scheduled: Optional[int] = None,
                  midstream_resumes: Optional[Dict[str, float]] = None,
+                 elastic: Optional[list] = None,
                  ) -> dict:
     """Assemble + validate the soak report (pure; tests feed it synthetic
     rung/fault data). ``midstream_resumes`` is the router's
-    router_midstream_resumes_total values by outcome, scraped at soak end."""
+    router_midstream_resumes_total values by outcome, scraped at soak end.
+    ``elastic`` carries the scale_out/scale_in event measurements
+    (docs/ELASTIC.md): engine_ready_s, time_to_first_slo_met_token_s and
+    the joining engine's first-minute kv-hit rates."""
     all_class = [c for rung in rungs for c in rung["classes"].values()]
     totals = {
         "requests": sum(c["requests"] for c in all_class),
@@ -358,6 +368,7 @@ def build_report(*, model: str, backend: str, num_engines: int,
         "midstream_resumes": midstream_resumes or {},
         "autoscaler_gauges": autoscaler_gauges,
         "router_slo_attainment": slo_attainment_gauge or {},
+        "elastic": elastic or [],
     }
     validate_report(report)
     return report
@@ -656,6 +667,106 @@ def _await_running(engine_url: str, timeout_s: float) -> bool:
     return False
 
 
+def _metric_values(metrics_text: str, name: str) -> List[float]:
+    """Every sample value of ``name`` (any label set) in exposition text."""
+    out = []
+    for line in metrics_text.splitlines():
+        if line.startswith(name + "{") or line.startswith(name + " "):
+            try:
+                out.append(float(line.rsplit(" ", 1)[1]))
+            except ValueError:
+                continue
+    return out
+
+
+def router_queue_depth_total(router_url: str) -> Optional[float]:
+    """Summed router_queue_depth over all backends — the scale-out signal
+    the local HPA emulation triggers on (docs/SOAK.md autoscaling)."""
+    try:
+        text = _scrape_text(f"{router_url}/metrics")
+    except OSError:
+        return None
+    vals = _metric_values(text, "router_queue_depth")
+    return sum(vals) if vals else None
+
+
+def engine_prefix_counters(engine_url: str) -> Optional[Tuple[float, ...]]:
+    """(prefix_hits, prefix_queries, restore_saved_tokens) from one
+    engine's /metrics — the first-minute kv_hit_rate inputs for a
+    scaled-out engine (docs/ELASTIC.md)."""
+    try:
+        text = _scrape_text(f"{engine_url}/metrics")
+    except OSError:
+        return None
+
+    def one(name):
+        vals = _metric_values(text, name)
+        return vals[0] if vals else 0.0
+
+    return (one("vllm:gpu_prefix_cache_hits_total"),
+            one("vllm:gpu_prefix_cache_queries_total"),
+            one("pstpu:kv_restore_saved_tokens_total"))
+
+
+def engine_startup_stats(engine_url: str) -> dict:
+    """The pstpu:startup_* fast-start telemetry of one engine."""
+    try:
+        text = _scrape_text(f"{engine_url}/metrics")
+    except OSError:
+        return {}
+    out = {}
+    for key in ("startup_weight_load_seconds", "startup_compile_seconds",
+                "startup_warmup_seconds", "startup_prewarm_seconds",
+                "startup_total_seconds", "startup_cache_hit_families",
+                "startup_cache_miss_families"):
+        vals = _metric_values(text, f"pstpu:{key}")
+        if vals:
+            out[key] = round(vals[0], 4)
+    return out
+
+
+def _ttft_met_count(metrics_text: str, slo_s: float) -> int:
+    """Requests whose TTFT landed within ``slo_s``, from the engine's own
+    vllm:time_to_first_token_seconds histogram: the cumulative count of
+    the largest bucket bound <= slo_s."""
+    import re
+
+    best_bound, best_count = -1.0, 0
+    for line in metrics_text.splitlines():
+        if not line.startswith("vllm:time_to_first_token_seconds_bucket"):
+            continue
+        m = re.search(r'le="([^"]+)"', line)
+        if not m or m.group(1) == "+Inf":
+            continue
+        try:
+            bound = float(m.group(1))
+            count = int(float(line.rsplit(" ", 1)[1]))
+        except ValueError:
+            continue
+        if bound <= slo_s and bound > best_bound:
+            best_bound, best_count = bound, count
+    return best_count
+
+
+def _await_slo_met_token(engine_url: str, slo_s: float,
+                         timeout_s: float) -> Optional[float]:
+    """Seconds until the engine's OWN TTFT histogram first records a
+    request within ``slo_s`` — the joining engine's
+    time-to-first-SLO-met-token clock tail (docs/ELASTIC.md). None if it
+    never happens within the timeout."""
+    t0 = time.monotonic()
+    deadline = t0 + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            text = _scrape_text(f"{engine_url}/metrics")
+            if _ttft_met_count(text, slo_s) > 0:
+                return time.monotonic() - t0
+        except OSError:
+            pass
+        time.sleep(0.25)
+    return None
+
+
 def _post_fault(engine_url: str, payload: dict) -> dict:
     """POST /fault to an engine (fake engines serve it; real engines 404 —
     recorded as skipped, the schedule keeps going)."""
@@ -677,12 +788,105 @@ def _post_fault(engine_url: str, payload: dict) -> dict:
         raise
 
 
-def make_stack_executor(stack, kv_handle=None) -> Callable:
+def make_stack_executor(stack, kv_handle=None,
+                        classes: Sequence[SLOClass] = (),
+                        elastic_log: Optional[list] = None) -> Callable:
     """Chaos executor bound to the subprocess stack: restarts run in a
     worker thread (they block on process exit + /health) so the event
-    loop keeps relaying soak traffic throughout."""
+    loop keeps relaying soak traffic throughout.
+
+    ``classes`` supplies the soft TTFT bound the scale-out events grade
+    time-to-first-SLO-met-token against (the tightest class); scale
+    events append their measurements to ``elastic_log`` so run_soak can
+    finish the first-minute kv_hit_rate windows after the ladder and
+    fold them into the report's ``elastic`` section (docs/ELASTIC.md)."""
+    slo_ttft = min((c.ttft_slo_s for c in classes), default=10.0)
 
     async def execute(fault: Fault) -> dict:
+        if fault.action == "scale_out_engine":
+            info: Dict = {}
+            # Local HPA emulation: record the exported signal that would
+            # have triggered the scale decision; "when_queue_depth" gates
+            # the event on the signal actually reaching the threshold
+            # (bounded by "wait_s" so a mis-sized schedule can't hang).
+            thresh = fault.params.get("when_queue_depth")
+            wait_s = float(fault.params.get("wait_s", 30.0))
+            depth = await asyncio.to_thread(
+                router_queue_depth_total, stack.router_url
+            )
+            if thresh is not None:
+                gate_deadline = time.monotonic() + wait_s
+                while (depth is None or depth < float(thresh)) and \
+                        time.monotonic() < gate_deadline:
+                    await asyncio.sleep(0.5)
+                    depth = await asyncio.to_thread(
+                        router_queue_depth_total, stack.router_url
+                    )
+                info["queue_depth_gate"] = float(thresh)
+            info["queue_depth_at_trigger"] = depth
+            # The clock starts at the scale DECISION (post-gate): the
+            # number answers "once the HPA fires, how long until the new
+            # capacity serves an SLO-met token".
+            t0 = time.monotonic()
+            res = await asyncio.to_thread(stack.scale_out, 300.0)
+            info.update(res)
+            info["slo_ttft_s"] = slo_ttft
+            info["startup"] = await asyncio.to_thread(
+                engine_startup_stats, res["url"]
+            )
+            if elastic_log is not None:
+                counters = await asyncio.to_thread(
+                    engine_prefix_counters, res["url"]
+                )
+                el = {
+                    "event": "scale_out", "url": res["url"],
+                    "joined_at": time.monotonic(),
+                    "counters_at_join": counters, **info,
+                }
+                # time-to-first-SLO-met-token: scale decision -> the
+                # first token the JOINING engine serves within the
+                # tightest class's soft TTFT target (its own histogram
+                # is the witness) — the metric the whole elastic path is
+                # graded on. Measured on a thread so later scheduled
+                # faults (e.g. the symmetric scale-in) fire on time;
+                # _finish_elastic_windows joins it before the report.
+                import threading
+
+                def _fill_slo():
+                    waited = _await_slo_met_token(
+                        res["url"], slo_ttft, 120.0
+                    )
+                    el["time_to_first_slo_met_token_s"] = (
+                        None if waited is None
+                        else round(time.monotonic() - t0, 3)
+                    )
+                    # Close the first-minute kv counter window ON TIME:
+                    # on ladders that outlast the join by more than the
+                    # window, a post-ladder scrape would measure the
+                    # steady state, not the first minute.
+                    remaining = el["joined_at"] + 60.0 - time.monotonic()
+                    if remaining > 0:
+                        time.sleep(remaining)
+                    el["_counters_at_window"] = engine_prefix_counters(
+                        res["url"]
+                    )
+                    el["_window_closed_at"] = time.monotonic()
+
+                th = threading.Thread(target=_fill_slo, daemon=True)
+                th.start()
+                el["_slo_thread"] = th
+                elastic_log.append(el)
+            return info
+        if fault.action == "scale_in_engine":
+            # Default target (engine 0 / unset) is the NEWEST engine —
+            # draining the scale-out's joiner is the symmetric HPA-down
+            # event; an explicit positive index picks a specific slot.
+            res = await asyncio.to_thread(
+                stack.scale_in, fault.engine or -1
+            )
+            if elastic_log is not None:
+                elastic_log.append({"event": "scale_in", **res})
+            return res
         if fault.action == "restart_engine":
             # Bounded health wait: a pod that cannot come back is a fault
             # log entry (and a failed recovery bar), not a hung soak.
@@ -729,10 +933,68 @@ def make_stack_executor(stack, kv_handle=None) -> Callable:
     return execute
 
 
-def run_soak(args) -> dict:
-    """bench.py --soak entry point: bring up the stack (N engines + router
-    + kv-offload server), run the ladder with the chaos schedule, scrape
-    the router's autoscaler gauges, and return the validated report."""
+def _finish_elastic_windows(elastic_log: list,
+                            window_s: float = 60.0,
+                            max_wait_s: float = 20.0) -> None:
+    """Close each scale-out event's first-minute kv-hit window
+    (docs/ELASTIC.md): wait until ``window_s`` after the join (bounded by
+    ``max_wait_s`` of extra waiting — a ladder that ended early measures
+    a shorter window and says so), scrape the joining engine's prefix
+    counters again, and record:
+
+      * ``first_minute_kv_hit_rate`` — hit/query token delta, counting
+        BOTH device hits and lazy shared-tier restores;
+      * ``first_minute_device_kv_hit_rate`` — the same with mid-request
+        tier restores subtracted: tokens served from ALREADY-resident
+        device KV, which is precisely what prewarm moves off the serving
+        path (a lazy restore also counts as a prefix hit, so the raw rate
+        alone can mask the prewarm effect)."""
+    for entry in elastic_log:
+        if entry.get("event") != "scale_out":
+            continue
+        th = entry.pop("_slo_thread", None)
+        if th is not None:
+            th.join(timeout=200.0)
+            entry.setdefault("time_to_first_slo_met_token_s", None)
+        c0 = entry.pop("counters_at_join", None)
+        joined = entry.pop("joined_at", None)
+        if c0 is None or joined is None:
+            continue
+        # Prefer the on-time snapshot the SLO thread took at join+60s; a
+        # ladder that ended sooner falls back to closing the (shorter)
+        # window here, bounded so report assembly never stalls long.
+        c1 = entry.pop("_counters_at_window", None)
+        closed = entry.pop("_window_closed_at", None)
+        if c1 is None:
+            remaining = joined + window_s - time.monotonic()
+            if remaining > 0:
+                time.sleep(min(remaining, max_wait_s))
+            c1 = engine_prefix_counters(entry["url"])
+            closed = time.monotonic()
+        entry["kv_window_s"] = round(closed - joined, 1)
+        # Re-scrape the startup phases: the join-time scrape can race the
+        # router-driven prewarm POST (startup_prewarm_seconds lands once
+        # the pull completes).
+        startup = engine_startup_stats(entry["url"])
+        if startup:
+            entry["startup"] = startup
+        if c1 is None:
+            continue
+        dh, dq = c1[0] - c0[0], c1[1] - c0[1]
+        drestored = c1[2] - c0[2]
+        entry["first_minute_kv_hit_rate"] = (
+            round(dh / dq, 4) if dq > 0 else None
+        )
+        entry["first_minute_device_kv_hit_rate"] = (
+            round(max(0.0, dh - drestored) / dq, 4) if dq > 0 else None
+        )
+
+
+def _run_soak_once(args, prewarm_top_k: int, ramp_in_s: float) -> dict:
+    """One full stack + ladder run (the body of run_soak; the elastic A/B
+    calls it twice — prewarm on, then off — against fresh stacks)."""
+    import tempfile
+
     from benchmarks.multi_round_qa import WorkloadConfig, run_workload
     from benchmarks.stack import launch_kv_server_handle, launch_stack
 
@@ -746,10 +1008,29 @@ def run_soak(args) -> dict:
         raise ValueError("--soak-qps-ladder must name at least one rung")
     faults = parse_fault_schedule(args.soak_fault_schedule) \
         if args.soak_fault_schedule else ()
+    has_scale_events = any(
+        f.action in ("scale_out_engine", "scale_in_engine") for f in faults
+    )
 
     kv_handle = launch_kv_server_handle()
+    dyn_cfg = None
     stack = None
+    elastic_log: list = []
     try:
+        if has_scale_events:
+            fd, dyn_cfg = tempfile.mkstemp(prefix="pstpu-soak-dyncfg-",
+                                           suffix=".json")
+            import os as _os
+
+            _os.close(fd)
+        router_args = [
+            "--session-key", "x-user-id",
+            "--breaker-half-open-dwell", "2.0",
+        ]
+        if ramp_in_s > 0:
+            router_args += ["--ramp-in-seconds", str(ramp_in_s)]
+        if prewarm_top_k > 0:
+            router_args += ["--prewarm-top-k", str(prewarm_top_k)]
         stack = launch_stack(
             args.model,
             engine_args=[
@@ -761,16 +1042,22 @@ def run_soak(args) -> dict:
                 *(["--no-warmup"] if not on_tpu else []),
             ],
             engine_env={"LMCACHE_REMOTE_URL": kv_handle.url},
-            routing_logic="session",
-            router_args=[
-                "--session-key", "x-user-id",
-                "--breaker-half-open-dwell", "2.0",
-            ],
+            routing_logic=getattr(args, "soak_routing_logic", "session"),
+            router_args=router_args,
             num_engines=args.num_engines,
             # Multi-chip soak (docs/PERF.md round 9): every engine on a
             # tp mesh — bench.py forces the virtual device platform on
             # CPU before this runs.
             tensor_parallel_size=getattr(args, "tensor_parallel_size", 1),
+            # Elastic scale events need the router to learn fleet changes
+            # fast: static discovery behind a dynamic-config file with a
+            # 1s watch. Chaos relaunches reuse the same cache dir, so
+            # restart recovery exercises the warm-start path.
+            compilation_cache_dir=getattr(
+                args, "compilation_cache_dir", None
+            ),
+            dynamic_config_path=dyn_cfg,
+            dynamic_config_watch_interval=1.0,
         )
         # Warmup: compile every measured shape before the ladder starts
         # (BENCH_r04's cold-compile lesson).
@@ -788,14 +1075,24 @@ def run_soak(args) -> dict:
             stack.router_url, args.model, classes, ladder,
             args.soak_rung_duration,
             faults=faults,
-            fault_executor=make_stack_executor(stack, kv_handle),
+            fault_executor=make_stack_executor(
+                stack, kv_handle, classes=classes, elastic_log=elastic_log,
+            ),
             max_recovery_s=args.soak_max_recovery,
         ))
+        _finish_elastic_windows(elastic_log)
         metrics_text = _scrape_text(f"{stack.router_url}/metrics")
     finally:
         if stack is not None:
             stack.terminate()
         kv_handle.terminate()
+        if dyn_cfg is not None:
+            import os as _os
+
+            try:
+                _os.unlink(dyn_cfg)
+            except OSError:
+                pass
 
     return build_report(
         model=args.model, backend=args.backend,
@@ -804,4 +1101,27 @@ def run_soak(args) -> dict:
         autoscaler_gauges=parse_autoscaler_gauges(metrics_text),
         slo_attainment_gauge=parse_slo_attainment(metrics_text),
         midstream_resumes=parse_midstream_resumes(metrics_text),
+        elastic=elastic_log,
     )
+
+
+def run_soak(args) -> dict:
+    """bench.py --soak entry point: bring up the stack (N engines + router
+    + kv-offload server), run the ladder with the chaos schedule, scrape
+    the router's autoscaler gauges, and return the validated report.
+
+    With --soak-elastic-ab the whole ladder runs TWICE against fresh
+    stacks — prewarm+ramp on, then off — and the report (the prewarmed
+    run) embeds the control's elastic measurements under
+    ``elastic_control``, making the prewarm effect on the joining
+    engine's first-minute kv-hit rate a recorded A/B, not a log line."""
+    prewarm = int(getattr(args, "soak_prewarm_top_k", 0) or 0)
+    ramp = float(getattr(args, "soak_ramp_in", 0.0) or 0.0)
+    report = _run_soak_once(args, prewarm_top_k=prewarm, ramp_in_s=ramp)
+    if getattr(args, "soak_elastic_ab", False):
+        print("soak elastic A/B: re-running the ladder with prewarm/ramp "
+              "OFF (control)", file=sys.stderr)
+        control = _run_soak_once(args, prewarm_top_k=0, ramp_in_s=0.0)
+        report["elastic_control"] = control.get("elastic", [])
+        report["elastic_control_zero_5xx"] = control.get("zero_5xx")
+    return report

@@ -6,6 +6,7 @@ routing, SSE relay) -> engine pod (reference tutorials/
 07-benchmark-multi-round-qa-single-gpu.md procedure).
 """
 
+import json
 import os
 import socket
 import subprocess
@@ -148,6 +149,118 @@ class StackHandle:
     engine_cmds: List[List[str]] = field(default_factory=list)
     engine_log_files: List[object] = field(default_factory=list)
     engine_env: Optional[dict] = None
+    # Elastic fast-start (docs/ELASTIC.md): per-engine process-spawn ->
+    # /health-200 seconds (initial launch, relaunches overwrite their
+    # slot, scale-outs append), the served model name, and — when the
+    # router runs static discovery behind a dynamic-config file — the
+    # file scale_out/scale_in rewrite so the router learns the new fleet.
+    engine_ready_seconds: List[float] = field(default_factory=list)
+    served_model: str = ""
+    dynamic_config_path: Optional[str] = None
+    dynamic_config_watch_interval: float = 10.0
+    log_dir: str = "/tmp"
+
+    def _write_dynamic_config(self) -> None:
+        assert self.dynamic_config_path is not None
+        doc = {
+            "service_discovery": "static",
+            "static_backends": ",".join(self.engine_urls),
+            "static_models": ",".join(
+                [self.served_model] * len(self.engine_urls)
+            ),
+        }
+        tmp = self.dynamic_config_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, self.dynamic_config_path)  # atomic vs the watcher
+
+    def scale_out(self, startup_timeout_s: float = 1800.0) -> dict:
+        """Add one engine to the running stack (the soak's local HPA
+        emulation, docs/ELASTIC.md): spawn engine 0's argv on a fresh
+        port (same flags — including any shared --compilation-cache-dir,
+        so the joiner takes the warm-start path), wait for /health, then
+        rewrite the router's dynamic-config file so static discovery
+        picks it up within the watch interval. Requires the stack to have
+        been launched with dynamic_config_path."""
+        if self.dynamic_config_path is None:
+            raise RuntimeError(
+                "scale_out requires launch_stack(dynamic_config_path=...) "
+                "(the router must be watching a dynamic config file)"
+            )
+        port = free_port()
+        url = f"http://127.0.0.1:{port}"
+        cmd = list(self.engine_cmds[0])
+        cmd[cmd.index("--port") + 1] = str(port)
+        elog = os.path.join(self.log_dir, f"pstpu-bench-engine-{port}.log")
+        elog_f = open(elog, "w")
+        env = ({**os.environ, **self.engine_env}
+               if self.engine_env else None)
+        t0 = time.monotonic()
+        proc = subprocess.Popen(
+            cmd, stdout=elog_f, stderr=subprocess.STDOUT, env=env,
+        )
+        try:
+            wait_health(f"{url}/health", startup_timeout_s, proc,
+                        f"engine {url} (scale-out)")
+        except Exception:
+            proc.kill()
+            elog_f.close()
+            raise
+        ready_s = time.monotonic() - t0
+        self.engines.append(proc)
+        self.engine_urls.append(url)
+        self.engine_cmds.append(cmd)
+        self.engine_log_files.append(elog_f)
+        self.engine_ready_seconds.append(ready_s)
+        self.log_paths.append(elog)
+        self.log_files.append(elog_f)
+        self._write_dynamic_config()
+        return {"url": url, "index": len(self.engines) - 1,
+                "engine_ready_s": round(ready_s, 3)}
+
+    def scale_in(self, index: int = -1,
+                 drain_timeout_s: float = 60.0) -> dict:
+        """Remove engine ``index`` (default: the newest) with zero 5xx:
+        the dynamic-config rewrite drops it from routing FIRST, the
+        watch interval is waited out (plus margin) so the router stops
+        picking it, then SIGTERM triggers the engine's graceful drain
+        (in-flight streams finish; its hot KV is already spilled to the
+        shared tier by the write-through offload path)."""
+        if self.dynamic_config_path is None:
+            raise RuntimeError(
+                "scale_in requires launch_stack(dynamic_config_path=...)"
+            )
+        if index < 0:
+            index = len(self.engines) + index
+        if not 0 <= index < len(self.engines) or len(self.engines) <= 1:
+            raise ValueError(f"cannot scale in engine {index} of "
+                             f"{len(self.engines)}")
+        proc = self.engines.pop(index)
+        url = self.engine_urls.pop(index)
+        self.engine_cmds.pop(index)
+        elog_f = self.engine_log_files.pop(index)
+        if index < len(self.engine_ready_seconds):
+            self.engine_ready_seconds.pop(index)
+        self._write_dynamic_config()
+        # Let the watcher apply the shrunken fleet before the drain
+        # starts, so no request is routed at a draining backend (the
+        # router's retry ladder would still absorb one, but the clean
+        # path is route-away-first).
+        time.sleep(self.dynamic_config_watch_interval + 1.0)
+        t0 = time.monotonic()
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=drain_timeout_s)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=30)
+        try:
+            self.log_files.remove(elog_f)
+        except ValueError:
+            pass
+        elog_f.close()
+        return {"url": url, "drain_s": round(time.monotonic() - t0, 3)}
 
     @property
     def engine(self) -> subprocess.Popen:
@@ -163,6 +276,7 @@ class StackHandle:
         block until /health is 200 again."""
         env = ({**os.environ, **self.engine_env}
                if self.engine_env else None)
+        t0 = time.monotonic()
         new = subprocess.Popen(
             self.engine_cmds[index],
             stdout=self.engine_log_files[index], stderr=subprocess.STDOUT,
@@ -171,6 +285,13 @@ class StackHandle:
         self.engines[index] = new
         wait_health(f"{self.engine_urls[index]}/health", startup_timeout_s,
                     new, f"engine {self.engine_urls[index]} (restarted)")
+        # The relaunch reuses the same argv (incl. any shared
+        # --compilation-cache-dir), so this measures the WARM-start path
+        # the chaos-recovery bars benefit from (docs/ELASTIC.md).
+        if index < len(self.engine_ready_seconds):
+            self.engine_ready_seconds[index] = time.monotonic() - t0
+        else:
+            self.engine_ready_seconds.append(time.monotonic() - t0)
 
     def restart_engine(self, index: int, startup_timeout_s: float = 1800.0,
                        kill_timeout_s: float = 60.0) -> float:
@@ -237,6 +358,9 @@ def launch_stack(
     per_engine_args: Optional[List[List[str]]] = None,
     engine_env: Optional[dict] = None,
     tensor_parallel_size: int = 1,
+    compilation_cache_dir: Optional[str] = None,
+    dynamic_config_path: Optional[str] = None,
+    dynamic_config_watch_interval: float = 1.0,
 ) -> StackHandle:
     """Start ``num_engines`` engine pods + the router; block until all are
     healthy. Multiple engines make the load-balancing routing logics
@@ -251,7 +375,17 @@ def launch_stack(
     extras can still override it per pod). On CPU the caller must also put
     ``--xla_force_host_platform_device_count=N`` into the subprocesses'
     XLA_FLAGS (bench.py does; the same code path IS the TPU slice path,
-    where the real devices are just present)."""
+    where the real devices are just present).
+
+    Elastic fast-start (docs/ELASTIC.md): ``compilation_cache_dir``
+    threads ``--compilation-cache-dir`` into every engine subprocess
+    (restarts and scale-outs reuse the argv, so relaunches boot warm);
+    ``dynamic_config_path`` makes the router watch a dynamic-config file
+    seeded with the initial fleet, enabling StackHandle.scale_out /
+    scale_in mid-run; per-engine spawn->/health seconds land in
+    StackHandle.engine_ready_seconds (healths are awaited sequentially,
+    so later engines' values include queue wait — use a 1-engine stack
+    for a clean cold/warm boot A/B)."""
     if tensor_parallel_size > 1:
         pea = [list(a) for a in (per_engine_args or [])]
         while len(pea) < max(1, num_engines):
@@ -268,6 +402,8 @@ def launch_stack(
     engine_urls: List[str] = []
     engine_cmds: List[List[str]] = []
     engine_log_files: List[object] = []
+    engine_spawn_times: List[float] = []
+    engine_ready_seconds: List[float] = []
     log_paths: List[str] = []
     log_files: List[object] = []
     rlog_f = None
@@ -289,9 +425,12 @@ def launch_stack(
                 sys.executable, "-m",
                 "production_stack_tpu.server.api_server",
                 "--model", model, "--port", str(engine_port),
+                *(["--compilation-cache-dir", compilation_cache_dir]
+                  if compilation_cache_dir is not None else []),
                 *(engine_args or []),
                 *extra,
             ]
+            engine_spawn_times.append(time.monotonic())
             engines.append(subprocess.Popen(
                 cmd,
                 stdout=elog_f, stderr=subprocess.STDOUT,
@@ -300,9 +439,24 @@ def launch_stack(
             engine_urls.append(engine_url)
             engine_cmds.append(cmd)
             engine_log_files.append(elog_f)
-        for engine, engine_url in zip(engines, engine_urls):
+        for engine, engine_url, spawn_t in zip(engines, engine_urls,
+                                               engine_spawn_times):
             wait_health(f"{engine_url}/health", startup_timeout_s, engine,
                         f"engine {engine_url}")
+            engine_ready_seconds.append(time.monotonic() - spawn_t)
+        dyn_args: List[str] = []
+        if dynamic_config_path is not None:
+            with open(dynamic_config_path, "w") as f:
+                json.dump({
+                    "service_discovery": "static",
+                    "static_backends": ",".join(engine_urls),
+                    "static_models": ",".join([served] * len(engine_urls)),
+                }, f)
+            dyn_args = [
+                "--dynamic-config-json", dynamic_config_path,
+                "--dynamic-config-watch-interval",
+                str(dynamic_config_watch_interval),
+            ]
         router_cmd = [
             sys.executable, "-m", "production_stack_tpu.router.app",
             "--port", str(router_port),
@@ -310,6 +464,7 @@ def launch_stack(
             "--static-backends", ",".join(engine_urls),
             "--static-models", ",".join([served] * len(engine_urls)),
             "--routing-logic", routing_logic,
+            *dyn_args,
             *(router_args or []),
         ]
         rlog = os.path.join(log_dir, f"pstpu-bench-router-{router_port}.log")
@@ -335,4 +490,9 @@ def launch_stack(
         router_url=router_url, log_paths=log_paths, log_files=log_files,
         engine_cmds=engine_cmds, engine_log_files=engine_log_files,
         engine_env=dict(engine_env) if engine_env else None,
+        engine_ready_seconds=engine_ready_seconds,
+        served_model=served,
+        dynamic_config_path=dynamic_config_path,
+        dynamic_config_watch_interval=dynamic_config_watch_interval,
+        log_dir=log_dir,
     )

@@ -180,6 +180,14 @@ class EngineConfig:
             os.path.expanduser("~/.cache/pstpu_xla"),
         )
     )
+    # Fast-start weight/compile overlap (docs/ELASTIC.md): load checkpoint
+    # weights on a background thread while warmup runs its compile-only
+    # AOT prepass against abstract weights — the IO-bound and CPU-bound
+    # halves of startup pipeline instead of serializing. Off by default so
+    # tests and warmup-less engines keep the serial path; the API server
+    # turns it on (like enable_warmup). Ignored with speculative decoding
+    # (the draft shares/loads weights during construction).
+    overlap_weight_load: bool = False
     # --- serving ---
     served_model_name: Optional[str] = None
 

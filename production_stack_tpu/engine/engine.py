@@ -72,6 +72,15 @@ class ServingEngine:
         params=None,
         num_kv_blocks: Optional[int] = None,
     ):
+        # Fast-start telemetry (docs/ELASTIC.md): construction begins the
+        # startup clock; start() closes it once warmup finishes and the
+        # engine is ready to serve (pstpu:startup_total_seconds).
+        self._startup_t0 = time.monotonic()
+        self.startup_total_seconds = 0.0
+        # Cumulative seconds spent serving POST /prewarm pulls (the
+        # router-driven hot-chain prefetch before a new engine takes load).
+        self.startup_prewarm_seconds = 0.0
+        self.prewarmed_blocks_total = 0
         self.config = config
         self.model_config = resolve_model_config(config.model)
         self.tokenizer = get_tokenizer(config.model, self.model_config)
@@ -154,6 +163,11 @@ class ServingEngine:
         # In-flight handoff publishes (background tasks): awaited at loop
         # exit so no accepted handoff is lost on shutdown.
         self._publish_tasks: Set = set()
+        # Queued POST /prewarm pulls (docs/ELASTIC.md): (request, future)
+        # pairs the engine loop serves between device steps — the
+        # host->device KV writes must be ordered with model dispatches,
+        # exactly like _apply_restores.
+        self._pending_prewarms: List = []
         self._step_counter = 0
         self._new_work = asyncio.Event()
         self._loop_task: Optional[asyncio.Task] = None
@@ -201,9 +215,14 @@ class ServingEngine:
     async def start(self) -> None:
         if self._running:
             return
+        loop = asyncio.get_running_loop()
         if self.config.enable_warmup:
-            loop = asyncio.get_running_loop()
             await loop.run_in_executor(None, self.runner.warmup)
+        else:
+            # Overlapped weight loading without warmup: join here so the
+            # engine never reports healthy with weights still in flight.
+            await loop.run_in_executor(None, self.runner.wait_for_weights)
+        self.startup_total_seconds = time.monotonic() - self._startup_t0
         self._running = True
         self._loop_task = asyncio.create_task(self._run_loop())
         logger.info(
@@ -495,6 +514,51 @@ class ServingEngine:
         self._pending_aborts.add(request_id)
         self._new_work.set()
 
+    # ----------------------------------------------------------- fast-start
+    async def prewarm(self, top_k: int = 8, max_blocks: int = 256) -> dict:
+        """Pull the shared tier's hottest prefix chains into the device
+        prefix cache (POST /prewarm, docs/ELASTIC.md). Queued for the
+        engine loop so the device KV writes are ordered with model
+        dispatches; resolves with the pull's telemetry. Degrades to a
+        no-op result (never an exception) without a shared tier."""
+        if self.offload is None or self.offload.remote is None:
+            return {"chains": 0, "blocks": 0,
+                    "reason": "no shared tier configured (LMCACHE_REMOTE_URL"
+                              " / --kv-remote-url)"}
+        if not self._running:
+            return {"chains": 0, "blocks": 0, "reason": "engine not running"}
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._pending_prewarms.append(
+            ({"top_k": int(top_k), "max_blocks": int(max_blocks)}, fut)
+        )
+        self._new_work.set()
+        return await fut
+
+    async def _apply_prewarms(self) -> None:
+        """Serve queued prewarm pulls between device steps (same ordering
+        discipline as _apply_restores: the loop awaits the executor-run
+        store fetch + device scatter, so no dispatch is issued
+        concurrently)."""
+        loop = asyncio.get_running_loop()
+        pending, self._pending_prewarms = self._pending_prewarms, []
+        for req, fut in pending:
+            t0 = time.monotonic()
+            try:
+                res = await loop.run_in_executor(
+                    None, self.offload.prewarm_hot_chains,
+                    req["top_k"], req["max_blocks"],
+                )
+            except Exception as e:  # noqa: BLE001 — loop must survive
+                logger.exception("Prewarm pull failed")
+                res = {"chains": 0, "blocks": 0,
+                       "reason": f"prewarm failed: {e}"}
+            res["seconds"] = round(time.monotonic() - t0, 4)
+            self.startup_prewarm_seconds += res["seconds"]
+            self.prewarmed_blocks_total += res.get("blocks", 0)
+            if not fut.done():
+                fut.set_result(res)
+
     # ------------------------------------------------------------ engine loop
     async def _run_loop(self) -> None:
         """Two-slot pipelined dispatch loop (config.async_pipeline /
@@ -606,6 +670,8 @@ class ServingEngine:
             self._apply_pending_aborts()
             if self._pending_restores:
                 await self._apply_restores()
+            if self._pending_prewarms:
+                await self._apply_prewarms()
             issue_failed = False
             while len(in_flight) < depth and not issue_failed:
                 batch = next_batch()
@@ -670,6 +736,11 @@ class ServingEngine:
         # Drain on shutdown so no accepted tokens are lost, and let
         # in-flight handoff publishes finish so accepted transfers reach
         # the store.
+        for _req, fut in self._pending_prewarms:
+            if not fut.done():
+                fut.set_result({"chains": 0, "blocks": 0,
+                                "reason": "engine stopping"})
+        self._pending_prewarms.clear()
         await drain()
         if self._publish_tasks:
             await asyncio.gather(*list(self._publish_tasks),
@@ -1010,6 +1081,18 @@ class ServingEngine:
             "spec_accepted_tokens_total":
                 self.runner.spec_accepted_tokens_total,
             "spec_acceptance_rate": self.runner.spec_acceptance_rate,
+            # Elastic fast-start (docs/ELASTIC.md): startup phase timings
+            # + the warmup persistent-compile-cache hit/miss split.
+            "startup_weight_load_seconds":
+                self.runner.startup_weight_load_seconds,
+            "startup_compile_seconds": self.runner.startup_compile_seconds,
+            "startup_warmup_seconds": self.runner.startup_warmup_seconds,
+            "startup_prewarm_seconds": self.startup_prewarm_seconds,
+            "startup_total_seconds": self.startup_total_seconds,
+            "startup_cache_hit_families":
+                self.runner.startup_cache_hit_families,
+            "startup_cache_miss_families":
+                self.runner.startup_cache_miss_families,
             "num_preemptions": self.scheduler.num_preemptions_total,
             "prompt_tokens_total": self.prompt_tokens_total,
             "generation_tokens_total": self.generation_tokens_total,

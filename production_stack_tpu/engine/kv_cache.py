@@ -109,6 +109,10 @@ class BlockPoolManager:
     def hash_of_block(self, blk: int) -> Optional[bytes]:
         return self._block_to_hash.get(blk)
 
+    def contains_hash(self, h: bytes) -> bool:
+        """Is this content hash resident in the device prefix index?"""
+        return h in self._hash_to_block
+
     def parent_hash(self, h: bytes) -> Optional[bytes]:
         """Parent hash in ``h``'s chain (the seed for chain roots); None if
         ``h`` is no longer registered."""
@@ -237,6 +241,25 @@ class BlockPoolManager:
         self._block_to_hash[blk] = h
         self._hash_parent[h] = prev_hash
         return h
+
+    def adopt_full_block(self, blk: int, h: bytes,
+                         parent_hash: bytes) -> bool:
+        """Content-address a block whose hash is ALREADY KNOWN (prewarm
+        restores from the shared tier arrive keyed by store hash, with no
+        token list to re-derive it from — docs/ELASTIC.md). The caller
+        owns ``blk`` (ref 1 from allocate_blocks) and has written its KV;
+        freeing it afterwards parks it in the evictable cached-free LRU
+        where future prompts hit it exactly like a locally computed
+        prefix block. False (and nothing registered) when the hash is
+        already resident — the caller should free the duplicate block."""
+        if not self.enable_prefix_caching or not h:
+            return False
+        if h in self._hash_to_block:
+            return False
+        self._hash_to_block[h] = blk
+        self._block_to_hash[blk] = h
+        self._hash_parent[h] = parent_hash
+        return True
 
     # ----------------------------------------------------------------- free
     def free_blocks(self, blocks: Sequence[int]) -> None:

@@ -116,6 +116,35 @@ class EngineMetricsCollector(Collector):
                     "Lifetime fraction of draft proposals accepted by "
                     "the target",
                     getattr(runner, "spec_acceptance_rate", 0.0))
+        # Elastic fast-start (docs/ELASTIC.md) — the text renderer exports
+        # the same seven series (PL004 keeps them aligned).
+        yield gauge("pstpu:startup_weight_load_seconds",
+                    "Seconds loading model weights at startup (overlaps "
+                    "compile with overlap_weight_load)",
+                    getattr(runner, "startup_weight_load_seconds", 0.0))
+        yield gauge("pstpu:startup_compile_seconds",
+                    "Seconds in the AOT compile-only warmup prepass "
+                    "(overlapped with the weight load)",
+                    getattr(runner, "startup_compile_seconds", 0.0))
+        yield gauge("pstpu:startup_warmup_seconds",
+                    "Seconds executing warmup shape families before "
+                    "serving",
+                    getattr(runner, "startup_warmup_seconds", 0.0))
+        yield gauge("pstpu:startup_prewarm_seconds",
+                    "Seconds serving POST /prewarm hot-chain pulls from "
+                    "the shared KV tier",
+                    getattr(eng, "startup_prewarm_seconds", 0.0))
+        yield gauge("pstpu:startup_total_seconds",
+                    "Engine construction to ready-to-serve, seconds",
+                    getattr(eng, "startup_total_seconds", 0.0))
+        yield gauge("pstpu:startup_cache_hit_families",
+                    "Warmup variants loaded from the persistent compile "
+                    "cache (no recompile)",
+                    getattr(runner, "startup_cache_hit_families", 0))
+        yield gauge("pstpu:startup_cache_miss_families",
+                    "Warmup variants that compiled from scratch (cold "
+                    "cache or changed config)",
+                    getattr(runner, "startup_cache_miss_families", 0))
         # Dispatch-pipeline overlap telemetry (two-slot prefill/decode
         # overlap, engine.py:_run_loop): the overlap win is observable.
         yield counter("pstpu:decode_dispatches_total",

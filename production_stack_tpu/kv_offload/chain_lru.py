@@ -197,6 +197,45 @@ class ChainStore:
         with self._lock:
             return self._parent.get(key)
 
+    def hot_chains(self, top_k: int,
+                   max_blocks: int = 4096) -> List[List[bytes]]:
+        """The ``top_k`` hottest prefix chains, each as store keys ordered
+        root -> leaf (the 'H' wire op's storage half; docs/ELASTIC.md
+        prewarm protocol).
+
+        "Hot" is recency: the LRU order is walked newest-first and each
+        unvisited entry's resident ancestor chain is emitted whole — a leaf
+        touch refreshes its ancestors root-first (_touch_chain), so the MRU
+        end of ``_data`` is exactly the leaf frontier of the most recently
+        served chains. Entries already covered by an earlier (hotter)
+        chain are skipped, so overlapping sessions that share a system
+        prompt yield one chain per distinct leaf, not duplicates.
+        Read-only: enumerating hot chains must not refresh recency (same
+        rule as residency())."""
+        out: List[List[bytes]] = []
+        seen: Set[bytes] = set()
+        budget = max_blocks
+        with self._lock:
+            for key in reversed(self._data):
+                if len(out) >= top_k or budget <= 0:
+                    break
+                if key in seen:
+                    continue
+                chain: List[bytes] = []
+                k: Optional[bytes] = key
+                walk: Set[bytes] = set()
+                while k is not None and k in self._data and k not in walk:
+                    chain.append(k)
+                    walk.add(k)
+                    k = self._parent.get(k)
+                chain.reverse()          # root first
+                seen.update(chain)
+                chain = chain[:budget]
+                budget -= len(chain)
+                if chain:
+                    out.append(chain)
+        return out
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._data)

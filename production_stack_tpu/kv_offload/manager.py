@@ -21,7 +21,7 @@ LMCACHE_MAX_LOCAL_CPU_SIZE, remote tier at LMCACHE_REMOTE_URL.
 
 import threading
 import time
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -340,6 +340,142 @@ class KVOffloadManager:
         self.block_manager.prefix_hits_total += restored
         logger.debug("Restored %d tokens from KV offload", restored)
         return restored
+
+    # -------------------------------------------------------------- prewarm
+    def prewarm_hot_chains(self, top_k: int = 8,
+                           max_blocks: int = 256) -> dict:
+        """Pull the shared tier's hottest prefix chains into the DEVICE
+        prefix cache before this engine takes load (docs/ELASTIC.md;
+        POST /prewarm). Discovery is one 'H' round trip (the chain-aware
+        LRU already knows its leaf frontier), residency one 'I', payloads
+        one 'M' — the existing batched restore pipeline. Restored blocks
+        are adopted into the prefix index and parked evictable, so the
+        first real prompts sharing those prefixes hit device KV instead
+        of recomputing — the same bytes, never different tokens.
+
+        Runs on the engine loop's executor BETWEEN device steps (the
+        caller orders it like _apply_restores). Returns telemetry; every
+        failure degrades to fewer prewarmed blocks, never an exception."""
+        out = {"chains": 0, "blocks": 0, "skipped_blocks": 0,
+               "already_resident": 0}
+        if self.remote is None:
+            out["reason"] = "no shared tier configured"
+            return out
+        try:
+            chains = self.remote.hot_chains(top_k, max_blocks=max_blocks)
+        except ConnectionError as e:
+            logger.warning("Prewarm hot-chains query failed: %s", e)
+            out["reason"] = f"hot-chains query failed: {e}"
+            return out
+        pfx = self._key_prefix
+        usable = []
+        for chain in chains:
+            # Only OUR dtype namespace: a bf16 pool must never splice q8|
+            # blocks (and vice versa) — same rule as the restore path.
+            if pfx:
+                keys = [k for k in chain if k.startswith(pfx)]
+                keys = keys if len(keys) == len(chain) else []
+            else:
+                keys = [] if any(
+                    k.startswith(b"q8|") for k in chain
+                ) else list(chain)
+            if keys:
+                usable.append(keys)
+        budget = min(
+            max_blocks,
+            # Never let a prewarm crowd out serving: cap at half the pool.
+            max(0, (self.block_manager.num_blocks - 1) // 2),
+        )
+        # Distinct keys only: overlapping chains share their ancestor
+        # prefixes (e.g. every session's chain starts at the system
+        # prompt), and the shared blocks must be fetched/written once.
+        flat: List[bytes] = []
+        seen_keys = set()
+        for keys in usable:
+            for k in keys:
+                if k not in seen_keys and len(flat) < budget:
+                    seen_keys.add(k)
+                    flat.append(k)
+        if not flat:
+            out["reason"] = "no usable chains"
+            return out
+        try:
+            resident = self.remote.index_query(flat)
+            blobs = self.remote.multi_get(
+                [k for k, r in zip(flat, resident) if r]
+            )
+        except ConnectionError as e:
+            logger.warning("Prewarm fetch failed: %s", e)
+            out["reason"] = f"fetch failed: {e}"
+            return out
+        blob_by_key: Dict[bytes, Optional[bytes]] = dict(
+            zip([k for k, r in zip(flat, resident) if r], blobs)
+        )
+        writes: List[Tuple[int, tuple]] = []
+        adopted: List[Tuple[int, bytes, bytes]] = []
+        # Hashes collected THIS call: adoption into the block manager only
+        # happens after the device write below, so without this set every
+        # chain sharing an ancestor prefix would re-allocate and re-write
+        # the same blocks once per chain.
+        pending: set = set()
+        for keys in usable:
+            for i, key in enumerate(keys):
+                h = key[len(pfx):]
+                if h in pending or self.block_manager.contains_hash(h):
+                    out["already_resident"] += 1
+                    continue
+                blob = blob_by_key.get(key)
+                if blob is None:
+                    # Evicted since 'H' (or residency miss): the rest of
+                    # this chain is unrestorable contiguously — stop it.
+                    out["skipped_blocks"] += len(keys) - i
+                    break
+                try:
+                    parent_key, inner = unpack_chain(blob)
+                    k, v, ks, vs = self.unpack(inner)
+                except Exception:  # noqa: BLE001 — corrupt blob: skip chain
+                    logger.warning("Prewarm blob %s undecodable; skipping "
+                                   "chain tail", key.hex()[:16])
+                    out["skipped_blocks"] += len(keys) - i
+                    break
+                if (ks is not None) != self._kv_quantized:
+                    out["skipped_blocks"] += len(keys) - i
+                    break
+                blks = self.block_manager.allocate_blocks(1)
+                if blks is None:
+                    out["skipped_blocks"] += len(keys) - i
+                    out["reason"] = "pool full"
+                    break
+                parent_hash = (
+                    parent_key[len(pfx):]
+                    if parent_key and parent_key.startswith(pfx) else
+                    (keys[i - 1][len(pfx):] if i > 0 else b"")
+                )
+                writes.append((blks[0], (k, v, ks, vs)))
+                adopted.append((blks[0], h, parent_hash))
+                pending.add(h)
+        if writes:
+            blks = [b for b, _ in writes]
+            k_np = np.stack([d[0] for _, d in writes])
+            v_np = np.stack([d[1] for _, d in writes])
+            if self._kv_quantized:
+                self.runner.write_blocks(
+                    blks, k_np, v_np,
+                    np.stack([d[2] for _, d in writes]),
+                    np.stack([d[3] for _, d in writes]),
+                )
+            else:
+                self.runner.write_blocks(blks, k_np, v_np)
+        for blk, h, parent_hash in adopted:
+            if self.block_manager.adopt_full_block(blk, h, parent_hash):
+                out["blocks"] += 1
+            else:
+                out["already_resident"] += 1
+            # Park it evictable (cached-free): serving allocations may
+            # reclaim it LRU like any other cached prefix block.
+            self.block_manager.free_blocks([blk])
+        out["chains"] = len(usable)
+        return out
 
     @property
     def chain_evictions_total(self) -> int:

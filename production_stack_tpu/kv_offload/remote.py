@@ -184,6 +184,29 @@ class RemoteKVClient:
             self._batched_ops_ok = False
         return [self.exists(k) for k in keys]
 
+    def hot_chains(self, top_k: int,
+                   max_blocks: int = 4096) -> List[List[bytes]]:
+        """The shared tier's hottest prefix chains ('H'), each a
+        root->leaf list of store keys — the prewarm protocol's discovery
+        half (docs/ELASTIC.md). Empty on servers that predate the op (the
+        native C++ server answers STATUS_ERROR) — prewarm then no-ops
+        rather than failing engine startup."""
+        status, payload = self._request(
+            b"H", b"", struct.pack("<II", top_k, max_blocks)
+        )
+        if status != STATUS_OK:
+            return []
+        try:
+            doc = json.loads(payload)
+            return [
+                [bytes.fromhex(k) for k in chain]
+                for chain in doc.get("chains", [])
+            ]
+        except (ValueError, TypeError) as e:
+            raise ConnectionError(
+                f"malformed hot-chains response: {e}"
+            ) from e
+
     def stats(self) -> dict:
         status, payload = self._request(b"T", b"")
         return json.loads(payload) if status == STATUS_OK else {}

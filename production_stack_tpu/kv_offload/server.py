@@ -153,6 +153,28 @@ class PyKVServer:
             if not self.store.delete(key):
                 return STATUS_MISSING, b""
             return STATUS_OK, b""
+        if op == b"H":
+            # Hot-chains query (docs/ELASTIC.md prewarm protocol): val =
+            # u32 top_k | u32 max_blocks; response = JSON
+            # {"chains": [[hex store key, ...root->leaf], ...]} ordered
+            # hottest first. Read-only like 'I' — enumerating hot chains
+            # must not refresh their recency. The native C++ server
+            # predates the op and answers STATUS_ERROR; clients treat
+            # that as "no hot chains".
+            try:
+                (top_k,) = struct.unpack_from("<I", val, 0)
+                (max_blocks,) = (
+                    struct.unpack_from("<I", val, 4) if len(val) >= 8
+                    else (4096,)
+                )
+            except struct.error:
+                return STATUS_ERROR, b""
+            chains = self.store.hot_chains(
+                min(top_k, 256), max_blocks=min(max_blocks, 65536)
+            )
+            return STATUS_OK, json.dumps({
+                "chains": [[k.hex() for k in chain] for chain in chains],
+            }).encode()
         if op == b"T":
             return STATUS_OK, json.dumps({
                 **self.store.stats(), "impl": "python",

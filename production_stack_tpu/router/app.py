@@ -349,11 +349,30 @@ def initialize_all(app: web.Application, args) -> None:
             "k8s", namespace=args.k8s_namespace, port=args.k8s_port,
             label_selector=args.k8s_label_selector,
         )
+    # Prefix prewarm push (docs/ELASTIC.md): when a NEW backend appears
+    # mid-run, POST /prewarm to it from the scraper thread so it pulls the
+    # shared tier's hottest chains before ramp-in sends it real traffic.
+    prewarm_top_k = getattr(args, "prewarm_top_k", 0)
+
+    def _prewarm_new_backend(url: str) -> None:
+        import requests
+
+        try:
+            resp = requests.post(
+                f"{url}/prewarm", json={"top_k": prewarm_top_k},
+                timeout=30,
+            )
+            logger.info("Prewarmed new backend %s: %s", url,
+                        resp.text.strip()[:200])
+        except Exception as e:  # noqa: BLE001 — prewarm is best-effort
+            logger.warning("Prewarm push to %s failed: %s", url, e)
+
     initialize_engine_stats_scraper(
         args.engine_stats_interval,
         # The per-backend /prefix_index poll only pays for itself when the
         # prefix-aware logic consumes it (docs/KV_ECONOMY.md).
         scrape_prefix_index=(args.routing_logic == "prefix-aware"),
+        on_new_backend=(_prewarm_new_backend if prewarm_top_k > 0 else None),
     )
     initialize_request_stats_monitor(args.request_stats_window)
     routing_kwargs = {}
@@ -369,6 +388,9 @@ def initialize_all(app: web.Application, args) -> None:
     initialize_routing_logic(
         args.routing_logic, session_key=args.session_key,
         block_reuse_timeout=args.block_reuse_timeout,
+        # Slow-start for joining backends (docs/ELASTIC.md); routers that
+        # don't score load accept-and-ignore it.
+        ramp_in_seconds=getattr(args, "ramp_in_seconds", 0.0),
         **routing_kwargs,
     )
     # getattr defaults keep pre-resilience arg namespaces (operator-rendered
@@ -443,7 +465,12 @@ def initialize_all(app: web.Application, args) -> None:
     if args.callbacks:
         app["callbacks"] = initialize_custom_callbacks(args.callbacks)
     if args.dynamic_config_json:
-        initialize_dynamic_config_watcher(args.dynamic_config_json)
+        initialize_dynamic_config_watcher(
+            args.dynamic_config_json,
+            watch_interval=getattr(
+                args, "dynamic_config_watch_interval", 10.0
+            ),
+        )
 
 
 async def _inprocess_request(app: web.Application, endpoint: str,

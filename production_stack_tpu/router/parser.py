@@ -66,8 +66,24 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="prefix-aware routing: weight of the backend load "
                         "score subtracted from the prefix match")
 
+    p.add_argument("--ramp-in-seconds", type=float, default=0.0,
+                   help="slow-start window for newly discovered backends "
+                        "(docs/ELASTIC.md): a joining engine's load score "
+                        "carries a penalty decaying linearly from 1.0 to 0 "
+                        "over this many seconds, so the "
+                        "least-loaded/cache-aware/prefix-aware policies "
+                        "ramp traffic onto it instead of an instant 1/N "
+                        "avalanche onto a cold KV pool (0 disables)")
+    p.add_argument("--prewarm-top-k", type=int, default=0,
+                   help="on discovering a NEW backend, POST /prewarm to it "
+                        "with this top-K so it pulls the shared tier's "
+                        "hottest prefix chains before taking load "
+                        "(docs/ELASTIC.md; 0 disables; engines without a "
+                        "shared tier no-op the request)")
     p.add_argument("--engine-stats-interval", type=float, default=10.0,
-                   help="seconds between engine /metrics scrape passes")
+                   help="seconds between engine /metrics scrape passes "
+                        "(newly discovered backends are additionally "
+                        "scraped immediately, docs/ELASTIC.md)")
     p.add_argument("--request-stats-window", type=float, default=60.0,
                    help="sliding window for router-side request stats, "
                         "seconds")
@@ -78,6 +94,12 @@ def parse_args(argv=None) -> argparse.Namespace:
 
     p.add_argument("--dynamic-config-json", default=None,
                    help="path to a hot-reloaded dynamic config JSON file")
+    p.add_argument("--dynamic-config-watch-interval", type=float,
+                   default=10.0,
+                   help="seconds between dynamic-config file polls (the "
+                        "scale-out discovery latency with static "
+                        "discovery behind a config file — the soak "
+                        "harness's local HPA emulation runs this at 1s)")
     p.add_argument("--feature-gates", default="",
                    help="comma-separated Name=true|false gates")
     p.add_argument("--pii-action", choices=["block", "redact"],
@@ -160,6 +182,10 @@ def validate_args(args: argparse.Namespace) -> None:
             )
     if getattr(args, "retry_max_attempts", 1) < 1:
         raise ValueError("--retry-max-attempts must be >= 1")
+    if getattr(args, "ramp_in_seconds", 0.0) < 0:
+        raise ValueError("--ramp-in-seconds must be >= 0")
+    if getattr(args, "prewarm_top_k", 0) < 0:
+        raise ValueError("--prewarm-top-k must be >= 0")
     if getattr(args, "max_midstream_resumes", 0) < 0:
         raise ValueError("--max-midstream-resumes must be >= 0")
     if not 0 < getattr(args, "breaker_error_rate", 0.5) <= 1:

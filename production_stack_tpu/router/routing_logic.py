@@ -37,6 +37,25 @@ class RoutingLogic:
     PREFIX_AWARE = "prefix-aware"
 
 
+def ramp_in_penalty(ep: EndpointInfo, ramp_in_seconds: float,
+                    now: Optional[float] = None) -> float:
+    """Slow-start load penalty for a freshly discovered backend
+    (docs/ELASTIC.md): decays linearly from 1.0 at discovery to 0.0 at
+    ``ramp_in_seconds``, added to the backend's load score so a joining
+    engine receives a growing share of traffic while its KV pool and
+    dispatch pipeline warm — instead of an instant 1/N avalanche onto a
+    stone-cold pool. It is a WEIGHT, not a gate: an engine with a strong
+    prefix match (or a saturated fleet) can still win mid-ramp. 0
+    disables. Discovery preserves ``added_timestamp`` across
+    re-discovery/reconfigure, so only genuinely new backends ramp."""
+    if ramp_in_seconds <= 0:
+        return 0.0
+    age = (now if now is not None else time.time()) - ep.added_timestamp
+    if age >= ramp_in_seconds or age < 0:
+        return 0.0
+    return 1.0 - age / ramp_in_seconds
+
+
 class RoutingInterface(metaclass=SingletonABCMeta):
     @abc.abstractmethod
     def route_request(
@@ -154,6 +173,7 @@ class CacheAwareLoadBalancingRouter(RoutingInterface):
         block_reuse_timeout: float = 300.0,
         cache_weight: float = 0.6,
         load_weight: float = 0.4,
+        ramp_in_seconds: float = 0.0,
         **_,
     ):
         if hasattr(self, "_initialized"):
@@ -163,6 +183,7 @@ class CacheAwareLoadBalancingRouter(RoutingInterface):
         self.block_reuse_timeout = block_reuse_timeout
         self.cache_weight = cache_weight
         self.load_weight = load_weight
+        self.ramp_in_seconds = ramp_in_seconds
         # session -> (engine_url, last_seen_ts)
         self._affinity = LRUCache(capacity=8192)
         self._rr = 0
@@ -216,6 +237,7 @@ class CacheAwareLoadBalancingRouter(RoutingInterface):
         for ep in sorted(endpoints, key=lambda e: e.url):
             hit = self._predict_cache_hit_rate(session_id, ep.url, engine_stats)
             load = self._engine_load_score(ep.url, engine_stats, request_stats)
+            load += ramp_in_penalty(ep, self.ramp_in_seconds)
             score = self.cache_weight * hit - self.load_weight * load
             if score > best_score:
                 best_url, best_score = ep.url, score
@@ -269,6 +291,7 @@ class PrefixAwareRouter(RoutingInterface):
         max_prefix_blocks: int = 512,
         index_ttl: float = 60.0,
         kv_down_cooldown: float = 30.0,
+        ramp_in_seconds: float = 0.0,
         **_,
     ):
         if hasattr(self, "_initialized"):
@@ -278,6 +301,7 @@ class PrefixAwareRouter(RoutingInterface):
         self.block_reuse_timeout = block_reuse_timeout
         self.prefix_weight = prefix_weight
         self.load_weight = load_weight
+        self.ramp_in_seconds = ramp_in_seconds
         self.max_prefix_blocks = max_prefix_blocks
         self.index_ttl = index_ttl
         self.kv_down_cooldown = kv_down_cooldown
@@ -531,7 +555,7 @@ class PrefixAwareRouter(RoutingInterface):
                 total = 1
             load = CacheAwareLoadBalancingRouter._engine_load_score(
                 ep.url, engine_stats, request_stats
-            )
+            ) + ramp_in_penalty(ep, self.ramp_in_seconds)
             score = (self.prefix_weight * (matched / total)
                      - self.load_weight * load)
             if score > best_score:
@@ -589,7 +613,7 @@ class PrefixAwareRouter(RoutingInterface):
         for ep in sorted(endpoints, key=lambda e: e.url):
             load = CacheAwareLoadBalancingRouter._engine_load_score(
                 ep.url, engine_stats, request_stats
-            )
+            ) + ramp_in_penalty(ep, getattr(self, "ramp_in_seconds", 0.0))
             if load < best:
                 best_url, best = ep.url, load
         if best_url is None:  # defensive; endpoints is never empty here
@@ -617,6 +641,7 @@ class DisaggRouter(RoutingInterface):
         self,
         session_key: Optional[str] = None,
         block_reuse_timeout: float = 300.0,
+        ramp_in_seconds: float = 0.0,
         **_,
     ):
         if hasattr(self, "_initialized"):
@@ -624,6 +649,7 @@ class DisaggRouter(RoutingInterface):
         self._initialized = True
         self.session_key = session_key
         self.block_reuse_timeout = block_reuse_timeout
+        self.ramp_in_seconds = ramp_in_seconds
         # session -> (decode_engine_url, last_seen_ts)
         self._affinity = LRUCache(capacity=8192)
         self._rr = 0
@@ -651,7 +677,7 @@ class DisaggRouter(RoutingInterface):
         for ep in sorted(endpoints, key=lambda e: e.url):
             load = CacheAwareLoadBalancingRouter._engine_load_score(
                 ep.url, engine_stats, request_stats
-            )
+            ) + ramp_in_penalty(ep, getattr(self, "ramp_in_seconds", 0.0))
             if load < best:
                 best_url, best = ep.url, load
         if best_url is None:  # defensive; endpoints is never empty here

@@ -60,16 +60,26 @@ class ServiceDiscovery:
 
 class StaticServiceDiscovery(ServiceDiscovery):
     """Fixed backend list from --static-backends/--static-models
-    (+ optional --static-backend-roles for disagg pools)."""
+    (+ optional --static-backend-roles for disagg pools).
+
+    ``known_timestamps`` ({url: added_timestamp}) carries discovery ages
+    across a reconfigure (dynamic-config scale-out): a backend that was
+    already serving must NOT get a fresh timestamp — the router's ramp-in
+    slow-start (docs/ELASTIC.md) keys on added_timestamp, and resetting it
+    would re-ramp the whole fleet every time one engine joins."""
 
     def __init__(self, urls: List[str], models: List[List[str]],
-                 roles: Optional[List[Optional[str]]] = None):
+                 roles: Optional[List[Optional[str]]] = None,
+                 known_timestamps: Optional[Dict[str, float]] = None):
         assert len(urls) == len(models), (urls, models)
         if roles is not None:
             assert len(roles) == len(urls), (urls, roles)
+        known = known_timestamps or {}
         self._endpoints = [
             EndpointInfo(url=u, model_names=list(m),
-                         role=(roles[i] if roles else None))
+                         role=(roles[i] if roles else None),
+                         **({"added_timestamp": known[u]} if u in known
+                            else {}))
             for i, (u, m) in enumerate(zip(urls, models))
         ]
 
@@ -270,7 +280,12 @@ class K8sPodIPServiceDiscovery(ServiceDiscovery):
                 logger.info("Discovery: adding engine %s at %s (%s, role=%s)",
                             name, url, models, role)
                 self._endpoints[name] = EndpointInfo(
-                    url=url, model_names=models, pod_name=name, role=role
+                    url=url, model_names=models, pod_name=name, role=role,
+                    # A metadata refresh (models/role) on a pod ALREADY
+                    # serving keeps its discovery age — the ramp-in
+                    # slow-start must not restart on label churn.
+                    **({"added_timestamp": known.added_timestamp}
+                       if known is not None and known.url == url else {}),
                 )
 
     # -------------------------------------------------------------- interface
@@ -292,11 +307,24 @@ _service_discovery: Optional[ServiceDiscovery] = None
 
 def initialize_service_discovery(kind: str, **kwargs) -> ServiceDiscovery:
     global _service_discovery
+    known_timestamps: Dict[str, float] = {}
     if _service_discovery is not None:
+        # Reconfigure (dynamic-config scale-out): surviving backends keep
+        # their discovery age so the router's ramp-in slow-start
+        # (docs/ELASTIC.md) applies only to the genuinely new ones.
+        try:
+            known_timestamps = {
+                ep.url: ep.added_timestamp
+                for ep in _service_discovery.get_endpoint_info()
+            }
+        except Exception:  # noqa: BLE001 — a dying watcher must not block
+            logger.warning("Could not snapshot endpoint ages before "
+                           "reconfigure", exc_info=True)
         _service_discovery.close()
     if kind == "static":
         _service_discovery = StaticServiceDiscovery(
-            kwargs["urls"], kwargs["models"], roles=kwargs.get("roles")
+            kwargs["urls"], kwargs["models"], roles=kwargs.get("roles"),
+            known_timestamps=known_timestamps,
         )
     elif kind == "k8s":
         _service_discovery = K8sPodIPServiceDiscovery(

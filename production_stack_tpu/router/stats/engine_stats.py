@@ -92,7 +92,9 @@ class PrefixIndexSnapshot:
 
 class EngineStatsScraper(metaclass=SingletonMeta):
     def __init__(self, scrape_interval: float = 10.0,
-                 scrape_prefix_index: bool = False):
+                 scrape_prefix_index: bool = False,
+                 discovery_poll_interval: float = 0.5,
+                 on_new_backend=None):
         if hasattr(self, "_initialized"):
             return
         self._initialized = True
@@ -102,9 +104,22 @@ class EngineStatsScraper(metaclass=SingletonMeta):
         # when the prefix-aware routing logic is active (the extra
         # request per backend per pass is pointless otherwise).
         self.scrape_prefix_index = scrape_prefix_index
+        # Elastic fast-start (docs/ELASTIC.md): between full passes the
+        # worker polls discovery at this cadence and scrapes any NEWLY
+        # appeared backend immediately (metrics + prefix index), instead
+        # of leaving it invisible to routing scores for up to a full
+        # scrape interval. ``on_new_backend(url)`` fires once per backend
+        # that appears AFTER the first full pass — the router wires the
+        # prefix-prewarm push through it. <= 0 disables the fast poll.
+        self.discovery_poll_interval = discovery_poll_interval
+        self.on_new_backend = on_new_backend
         self.engine_stats: Dict[str, EngineStats] = {}
         self.prefix_index: Dict[str, PrefixIndexSnapshot] = {}
         self._prev_counters: Dict[str, Tuple[float, float]] = {}
+        # URLs already seen by any pass: newness detection for the
+        # immediate scrape + the one-shot on_new_backend callback.
+        self._seen_urls: set = set()
+        self._first_pass_done = False
         self._lock = threading.Lock()
         self._last_scrape = time.time()  # construction counts as a pass
                                          # (health grace until first scrape)
@@ -122,14 +137,29 @@ class EngineStatsScraper(metaclass=SingletonMeta):
             except Exception:  # noqa: BLE001 — scraper must survive
                 logger.exception("Engine stats scrape pass failed")
             self._last_scrape = time.time()
-            time.sleep(self.scrape_interval)
+            deadline = time.monotonic() + self.scrape_interval
+            if self.discovery_poll_interval <= 0:
+                time.sleep(self.scrape_interval)
+                continue
+            while self._running and time.monotonic() < deadline:
+                time.sleep(min(self.discovery_poll_interval,
+                               max(0.0, deadline - time.monotonic())))
+                try:
+                    self._scrape_new_backends()
+                except Exception:  # noqa: BLE001 — scraper must survive
+                    logger.exception("Immediate scrape of new backend failed")
+
+    def _endpoints(self):
+        try:
+            return get_service_discovery().get_endpoint_info()
+        except AssertionError:
+            return None
 
     def _scrape_metrics(self) -> None:
         import requests
 
-        try:
-            endpoints = get_service_discovery().get_endpoint_info()
-        except AssertionError:
+        endpoints = self._endpoints()
+        if endpoints is None:
             return
         fresh: Dict[str, EngineStats] = {}
         fresh_index: Dict[str, PrefixIndexSnapshot] = {}
@@ -141,11 +171,56 @@ class EngineStatsScraper(metaclass=SingletonMeta):
                 snap = self._scrape_prefix_index(requests, ep.url)
                 if snap is not None:
                     fresh_index[ep.url] = snap
+        live = {ep.url for ep in endpoints}
         with self._lock:
             self.engine_stats = fresh
             # Departed/unscrapable backends drop out of the index entirely
             # (stale residency must not attract traffic).
             self.prefix_index = fresh_index
+            # Departed URLs forget their seen-ness so a pod that comes BACK
+            # counts as new again (it boots with a cold KV pool either way).
+            self._seen_urls = set(live)
+            self._first_pass_done = True
+
+    def _scrape_new_backends(self) -> None:
+        """Between full passes: scrape backends discovery has seen but no
+        scrape pass has (docs/ELASTIC.md fast-start). A new engine becomes
+        visible to routing scores (and the prefix-aware index) within
+        ``discovery_poll_interval`` instead of a full scrape interval, and
+        the one-shot ``on_new_backend`` hook fires for it — the router's
+        prewarm push."""
+        import requests
+
+        endpoints = self._endpoints()
+        if endpoints is None:
+            return
+        with self._lock:
+            first_pass_done = self._first_pass_done
+            new = [ep for ep in endpoints if ep.url not in self._seen_urls]
+            for ep in new:
+                self._seen_urls.add(ep.url)
+        for ep in new:
+            logger.info("Discovery surfaced new backend %s; scraping "
+                        "immediately", ep.url)
+            # Prewarm BEFORE the first scrape lands it in routing scores:
+            # the hot-chain pull is then (mostly) done by the time traffic
+            # starts scoring this backend.
+            if first_pass_done and self.on_new_backend is not None:
+                try:
+                    self.on_new_backend(ep.url)
+                except Exception:  # noqa: BLE001 — hook must not kill scraper
+                    logger.exception("on_new_backend hook failed for %s",
+                                     ep.url)
+            stats = self._scrape_one_endpoint(requests, ep.url)
+            snap = (
+                self._scrape_prefix_index(requests, ep.url)
+                if self.scrape_prefix_index else None
+            )
+            with self._lock:
+                if stats is not None:
+                    self.engine_stats = {**self.engine_stats, ep.url: stats}
+                if snap is not None:
+                    self.prefix_index = {**self.prefix_index, ep.url: snap}
 
     def _scrape_one_endpoint(self, requests_mod, url: str) -> Optional[EngineStats]:
         try:
@@ -201,8 +276,11 @@ class EngineStatsScraper(metaclass=SingletonMeta):
 def initialize_engine_stats_scraper(
     scrape_interval: float = 10.0,
     scrape_prefix_index: bool = False,
+    discovery_poll_interval: float = 0.5,
+    on_new_backend=None,
 ) -> EngineStatsScraper:
-    return EngineStatsScraper(scrape_interval, scrape_prefix_index)
+    return EngineStatsScraper(scrape_interval, scrape_prefix_index,
+                              discovery_poll_interval, on_new_backend)
 
 
 def get_engine_stats_scraper() -> EngineStatsScraper:

@@ -238,6 +238,7 @@ class APIServer:
         app.router.add_get("/health", self.health)
         app.router.add_get("/metrics", self.metrics)
         app.router.add_get("/prefix_index", self.prefix_index)
+        app.router.add_post("/prewarm", self.prewarm)
         app.router.add_get("/version", self.version)
         return app
 
@@ -362,6 +363,34 @@ class APIServer:
             "entries": entries,
             "truncated": truncated,
         })
+
+    async def prewarm(self, request: web.Request) -> web.Response:
+        """Prefix prewarm (docs/ELASTIC.md): pull the shared KV tier's
+        top-K hottest chains into the device prefix cache through the
+        batched 'H'/'I'/'M' restore pipeline, so a freshly scaled-out
+        engine's first prompts hit warm KV instead of recomputing. Driven
+        by the router on backend discovery (--prewarm-top-k); idempotent
+        and safe mid-serving (writes are ordered between device steps).
+        Prewarm only moves KV bytes — it never changes tokens."""
+        if self._draining:
+            return _error(503, "Server is draining",
+                          etype="service_unavailable",
+                          headers={"Retry-After": "5"})
+        raw = await request.read()
+        try:
+            body = json.loads(raw) if raw else {}
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return _error(400, "Request body is not valid JSON")
+        top_k = body.get("top_k", 8)
+        max_blocks = body.get("max_blocks", 256)
+        for name, v in (("top_k", top_k), ("max_blocks", max_blocks)):
+            if type(v) is bool or not isinstance(v, int) or not \
+                    1 <= v <= 65536:
+                return _error(400, f"'{name}' must be an integer in "
+                                   f"[1, 65536]")
+        result = await self.engine.prewarm(top_k=top_k,
+                                           max_blocks=max_blocks)
+        return web.json_response({"status": "ok", **result})
 
     async def version(self, request: web.Request) -> web.Response:
         return web.json_response({"version": VERSION})
@@ -1214,6 +1243,9 @@ def build_engine_from_args(args: argparse.Namespace) -> ServingEngine:
         **({"speculative_draft_window": args.speculative_draft_window}
            if args.speculative_draft_window is not None else {}),
         enable_warmup=not args.no_warmup,
+        overlap_weight_load=not args.no_overlap_weight_load,
+        **({"compilation_cache_dir": args.compilation_cache_dir}
+           if args.compilation_cache_dir is not None else {}),
         overlap_dispatch=not args.no_overlap_dispatch,
         pipeline_depth=args.pipeline_depth,
         lora_modules=_parse_lora_modules(args.lora_modules),
@@ -1281,6 +1313,17 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "vs gathered window by worst-case window size)")
     p.add_argument("--no-warmup", action="store_true",
                    help="Skip AOT warmup compilation at startup")
+    p.add_argument("--compilation-cache-dir", default=None,
+                   help="persistent XLA compile-cache directory "
+                        "(PVC-mountable): warm boots load step executables "
+                        "from it instead of recompiling — the engine "
+                        "fast-start path (docs/ELASTIC.md). Default: "
+                        "$PSTPU_COMPILATION_CACHE or ~/.cache/pstpu_xla; "
+                        "an empty string disables")
+    p.add_argument("--no-overlap-weight-load", action="store_true",
+                   help="Fallback: load weights serially before warmup "
+                        "instead of overlapping the checkpoint read with "
+                        "the AOT compile prepass (docs/ELASTIC.md)")
     p.add_argument("--no-overlap-dispatch", action="store_true",
                    help="Fallback: disable the two-slot prefill/decode "
                         "dispatch overlap (one batch kind per scheduling "

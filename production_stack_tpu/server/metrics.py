@@ -28,7 +28,11 @@ def render_engine_metrics(engine: "ServingEngine", model_name: str) -> str:
                 "kv_shared_tier_hits_total", "kv_shared_tier_misses_total",
                 "kv_chain_evictions_total", "resume_restored_tokens_total",
                 "spec_enabled", "spec_draft_tokens_total",
-                "spec_accepted_tokens_total", "spec_acceptance_rate"):
+                "spec_accepted_tokens_total", "spec_acceptance_rate",
+                "startup_weight_load_seconds", "startup_compile_seconds",
+                "startup_warmup_seconds", "startup_prewarm_seconds",
+                "startup_total_seconds", "startup_cache_hit_families",
+                "startup_cache_miss_families"):
         s.setdefault(key, 0)
     s.setdefault("disagg_role", "unified")
     s.setdefault("kv_cache_dtype", "bfloat16")
@@ -138,6 +142,44 @@ def render_engine_metrics(engine: "ServingEngine", model_name: str) -> str:
         "# TYPE pstpu:spec_acceptance_rate gauge",
         f"pstpu:spec_acceptance_rate{label} "
         f"{s['spec_acceptance_rate']:.6f}",
+        # Elastic fast-start (docs/ELASTIC.md): startup phase durations +
+        # the warmup persistent-compile-cache hit/miss split (the
+        # collector renders the same seven series).
+        "# HELP pstpu:startup_weight_load_seconds Seconds loading model "
+        "weights at startup (overlaps compile with overlap_weight_load)",
+        "# TYPE pstpu:startup_weight_load_seconds gauge",
+        f"pstpu:startup_weight_load_seconds{label} "
+        f"{s['startup_weight_load_seconds']:.6f}",
+        "# HELP pstpu:startup_compile_seconds Seconds in the AOT "
+        "compile-only warmup prepass (overlapped with the weight load)",
+        "# TYPE pstpu:startup_compile_seconds gauge",
+        f"pstpu:startup_compile_seconds{label} "
+        f"{s['startup_compile_seconds']:.6f}",
+        "# HELP pstpu:startup_warmup_seconds Seconds executing warmup "
+        "shape families before serving",
+        "# TYPE pstpu:startup_warmup_seconds gauge",
+        f"pstpu:startup_warmup_seconds{label} "
+        f"{s['startup_warmup_seconds']:.6f}",
+        "# HELP pstpu:startup_prewarm_seconds Seconds serving POST "
+        "/prewarm hot-chain pulls from the shared KV tier",
+        "# TYPE pstpu:startup_prewarm_seconds gauge",
+        f"pstpu:startup_prewarm_seconds{label} "
+        f"{s['startup_prewarm_seconds']:.6f}",
+        "# HELP pstpu:startup_total_seconds Engine construction to "
+        "ready-to-serve, seconds",
+        "# TYPE pstpu:startup_total_seconds gauge",
+        f"pstpu:startup_total_seconds{label} "
+        f"{s['startup_total_seconds']:.6f}",
+        "# HELP pstpu:startup_cache_hit_families Warmup variants loaded "
+        "from the persistent compile cache (no recompile)",
+        "# TYPE pstpu:startup_cache_hit_families gauge",
+        f"pstpu:startup_cache_hit_families{label} "
+        f"{s['startup_cache_hit_families']}",
+        "# HELP pstpu:startup_cache_miss_families Warmup variants that "
+        "compiled from scratch (cold cache or changed config)",
+        "# TYPE pstpu:startup_cache_miss_families gauge",
+        f"pstpu:startup_cache_miss_families{label} "
+        f"{s['startup_cache_miss_families']}",
         # Two-slot dispatch-pipeline telemetry (engine.py:_run_loop): the
         # prefill/decode overlap win is observable, not asserted.
         "# HELP pstpu:decode_dispatches_total Fused decode dispatches issued",

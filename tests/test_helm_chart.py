@@ -354,3 +354,73 @@ def test_real_helm_template_agrees_with_helm_lite(values_file):
         f"helm vs helm_lite drift for {os.path.basename(values_file)}: "
         f"only-helm={real - lite} only-lite={lite - real}"
     )
+
+
+def test_elastic_values_render_engine_and_router_flags():
+    """tpuConfig.compilationCacheDir renders --compilation-cache-dir on
+    the engine; routerSpec.rampInSeconds/prewarmTopK render
+    --ramp-in-seconds/--prewarm-top-k on the router (docs/ELASTIC.md).
+    Both parse with the real CLI parsers and satisfy the schema."""
+    values = {
+        "servingEngineSpec": {
+            "runtimeClassName": "",
+            "modelSpec": [{
+                "name": "elastic",
+                "repository": "production-stack-tpu/engine",
+                "tag": "latest",
+                "modelURL": "llama-1b",
+                "replicaCount": 1,
+                "requestCPU": 4,
+                "requestMemory": "16Gi",
+                "requestGPU": 1,
+                "tpuConfig": {
+                    "compilationCacheDir": "/cache/pstpu-xla",
+                    "overlapWeightLoad": False,
+                },
+            }],
+        },
+        "routerSpec": {
+            "serviceDiscovery": "k8s",
+            "routingLogic": "cache_aware_load_balancing",
+            "sessionKey": "x-user-id",
+            "rampInSeconds": 45,
+            "prewarmTopK": 8,
+        },
+    }
+    manifests = render_chart(CHART, values=values, release_name="stack")
+    engine = next(
+        m for m in _by_kind(manifests, "Deployment")
+        if m["metadata"]["name"].endswith("deployment-engine")
+    )
+    eargs = [str(a) for a in _container(engine, "engine")["args"]]
+    assert eargs[eargs.index("--compilation-cache-dir") + 1] == \
+        "/cache/pstpu-xla"
+    assert "--no-overlap-weight-load" in eargs
+    from production_stack_tpu.server.api_server import (
+        parse_args as engine_parse_args,
+    )
+
+    ns = engine_parse_args(eargs)
+    assert ns.compilation_cache_dir == "/cache/pstpu-xla"
+    assert ns.no_overlap_weight_load is True
+
+    router = next(
+        m for m in _by_kind(manifests, "Deployment")
+        if m["metadata"]["name"].endswith("deployment-router")
+    )
+    rargs = [str(a) for a in _container(router, "router")["args"]]
+    assert rargs[rargs.index("--ramp-in-seconds") + 1] == "45"
+    assert rargs[rargs.index("--prewarm-top-k") + 1] == "8"
+    from production_stack_tpu.router.parser import (
+        parse_args as router_parse_args,
+    )
+
+    rns = router_parse_args(rargs)
+    assert rns.ramp_in_seconds == 45.0
+    assert rns.prewarm_top_k == 8
+    jsonschema = pytest.importorskip("jsonschema")
+    import json
+
+    with open(os.path.join(CHART, "values.schema.json")) as f:
+        schema = json.load(f)
+    jsonschema.validate(values, schema)

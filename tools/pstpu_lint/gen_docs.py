@@ -35,6 +35,7 @@ TABLES = {
     "kv-economy": "docs/KV_ECONOMY.md",
     "speculative": "docs/PERF.md",
     "multichip": "docs/PERF.md",
+    "elastic": "docs/ELASTIC.md",
 }
 
 FLAG_TABLES = {

@@ -164,6 +164,33 @@ REGISTRY: Tuple[Series, ...] = (
     Series("pstpu:spec_acceptance_rate", "gauge", ("model_name",),
            _BOTH_ENGINE, ("catalogue", "speculative"),
            "Lifetime fraction of draft proposals accepted by the target"),
+    # --------------------------------------------- engine: elastic fast-start
+    Series("pstpu:startup_weight_load_seconds", "gauge", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "elastic"),
+           "Seconds loading model weights at startup (overlaps compile "
+           "with overlap_weight_load)"),
+    Series("pstpu:startup_compile_seconds", "gauge", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "elastic"),
+           "Seconds in the AOT compile-only warmup prepass (overlapped "
+           "with the weight load)"),
+    Series("pstpu:startup_warmup_seconds", "gauge", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "elastic"),
+           "Seconds executing warmup shape families before serving"),
+    Series("pstpu:startup_prewarm_seconds", "gauge", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "elastic"),
+           "Seconds serving POST /prewarm hot-chain pulls from the shared "
+           "KV tier"),
+    Series("pstpu:startup_total_seconds", "gauge", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "elastic"),
+           "Engine construction to ready-to-serve, seconds"),
+    Series("pstpu:startup_cache_hit_families", "gauge", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "elastic"),
+           "Warmup variants loaded from the persistent compile cache "
+           "(no recompile)"),
+    Series("pstpu:startup_cache_miss_families", "gauge", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "elastic"),
+           "Warmup variants that compiled from scratch (cold cache or "
+           "changed config)"),
     # --------------------------------------------- engine: mid-stream resume
     Series("pstpu:resume_restored_tokens_total", "counter", ("model_name",),
            _BOTH_ENGINE, ("catalogue", "resume"),
