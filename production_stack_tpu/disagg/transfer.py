@@ -15,7 +15,9 @@ the prefill engine started, with zero recompute and token-identical output:
     replays the exact client-visible result instead of re-deriving
     stop-trim corner cases.
 
-Wire layout (little-endian):
+Wire layout (little-endian; ``PDX1`` is registered in
+``tools/pstpu_lint/wire_registry.py`` and documented in
+docs/WIRE_FORMATS.md — PL010 enforces both directions stay implemented):
 
   PDX1 | u32 header_len | header JSON | (u64 blob_len | serde block blob)*
 

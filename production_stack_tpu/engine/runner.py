@@ -152,6 +152,7 @@ def _setup_compilation_cache(cache_dir: str) -> Optional[str]:
     try:
         try:
             kind = jax.local_devices()[0].device_kind
+        # pstpu-lint: allow[PL003] reason=cache-key probe; any failure means "unknown kind" and the outer handler logs real cache breakage
         except Exception:  # noqa: BLE001 — backend probe must never be fatal
             kind = "unknown"
         fingerprint = re.sub(
@@ -174,6 +175,7 @@ def _setup_compilation_cache(cache_dir: str) -> Optional[str]:
     # compiles cached, so no min-compile-time filter.
     try:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    # pstpu-lint: allow[PL003] reason=optional jax knob added later than cache_dir; absence is expected on older jax and changes nothing
     except Exception:  # noqa: BLE001 — knob added later than cache_dir
         pass
     return cache_dir
@@ -715,7 +717,11 @@ class ModelRunner:
                 for sh in pool.addressable_shards:
                     dev = f"{sh.device.platform}:{sh.device.id}"
                     out[dev] = out.get(dev, 0) + int(sh.data.nbytes)
-        except Exception:  # noqa: BLE001 — donated mid-step; keep last
+        except (RuntimeError, ValueError):  # donated mid-step; keep last
+            # The donation race surfaces as RuntimeError on TPU and
+            # ValueError INVALID_ARGUMENT on the CPU backend — the same
+            # pair read_blocks_retry retries on. Anything else is a real
+            # bug that must surface, not a stale-but-plausible gauge.
             return getattr(self, "_last_device_kv_bytes", {})
         self._last_device_kv_bytes = out
         return out
@@ -763,6 +769,7 @@ class ModelRunner:
             stats = jax.local_devices()[0].memory_stats()
             if stats and "bytes_limit" in stats:
                 free_bytes = stats["bytes_limit"] - stats.get("bytes_in_use", 0)
+        # pstpu-lint: allow[PL003] reason=memory_stats probe; unsupported backends fall through to the conservative 2 GiB default below
         except Exception:  # noqa: BLE001 — memory_stats unsupported on CPU
             pass
         if free_bytes is None:
@@ -2888,7 +2895,10 @@ class ModelRunner:
                 if self.kv_quantized and not deleted:
                     deleted = (self.kv_k_scale.is_deleted()
                                or self.kv_v_scale.is_deleted())
-            except Exception:  # noqa: BLE001 — treat unprobeable as gone
+            except (RuntimeError, ValueError):  # donation race mid-probe
+                # The observed donation-race pair (TPU RuntimeError / CPU
+                # ValueError); an unprobeable pool is treated as consumed
+                # and rebuilt — strictly safe, warmup runs before any KV.
                 deleted = True
             if deleted:
                 logger.warning(
@@ -2899,7 +2909,7 @@ class ModelRunner:
                 try:
                     spec_gone = (self.spec_k.is_deleted()
                                  or self.spec_pos.is_deleted())
-                except Exception:  # noqa: BLE001 — treat unprobeable as gone
+                except (RuntimeError, ValueError):  # donation race mid-probe
                     spec_gone = True
                 if spec_gone:
                     self._alloc_spec_pools()

@@ -16,6 +16,12 @@ request in flight per connection; the client serializes with a lock
 executor, never the event loop). The native C++ server predates 'D'/'M'/
 'I' and answers them with STATUS_ERROR; delete() treats that as "not
 deleted" and the batched ops degrade to per-key loops.
+
+The op set and per-op native coverage are registered in
+``tools/pstpu_lint/wire_registry.py`` (rendered into docs/WIRE_FORMATS.md);
+PL010 keeps this client, the Python server, and the native server in
+lockstep — adding an op here without a server dispatch (or a registry
+entry deciding its native story) fails the lint.
 """
 
 import json

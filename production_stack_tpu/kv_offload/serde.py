@@ -5,6 +5,11 @@ same spirit as the reference's ``serde: "naive"`` LMCache option (reference
 tutorials/assets/values-06-shared-storage.yaml). One value packs a block's K
 and V: two arrays of shape [L, Hkv, block_size, Dh].
 
+Every magic here is registered in ``tools/pstpu_lint/wire_registry.py``
+(the canonical lineage, rendered into docs/WIRE_FORMATS.md); the PL010
+lint rule keeps encoder and decoder coverage in lockstep — a new version
+must ship BOTH directions plus a registry entry.
+
 Two wire versions, distinguished by the magic (the header is the version
 tag, so a store holding blobs from both generations keeps decoding):
 

@@ -172,6 +172,14 @@ class EngineStatsScraper(metaclass=SingletonMeta):
                 if snap is not None:
                     fresh_index[ep.url] = snap
         live = {ep.url for ep in endpoints}
+        # Departed backends also drop their delta baselines (worker-thread
+        # state, never touched from the loop): without this the map grows
+        # per pod ever seen, and a pod that comes BACK after a restart
+        # would compute its first hit-rate delta against pre-restart
+        # counters (negative deltas -> a bogus 0.0 interval).
+        self._prev_counters = {
+            u: c for u, c in self._prev_counters.items() if u in live
+        }
         with self._lock:
             self.engine_stats = fresh
             # Departed/unscrapable backends drop out of the index entirely

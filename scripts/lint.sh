@@ -26,11 +26,13 @@ else
 fi
 
 if python -m mypy --version >/dev/null 2>&1; then
-    # Scope: the router + disagg tiers (the asyncio data plane, where type
-    # confusion turns into 3am pages). Widen as annotations land; config
+    # Scope: the router + disagg + kv_offload tiers (the asyncio data
+    # plane and the wire-protocol codecs, where type confusion turns into
+    # 3am pages or corrupted stores). Widen as annotations land; config
     # and per-flag rationale live under [tool.mypy] in pyproject.toml.
-    echo "== mypy (scoped: router/ + disagg/)"
+    echo "== mypy (scoped: router/ + disagg/ + kv_offload/)"
     python -m mypy production_stack_tpu/router production_stack_tpu/disagg \
+        production_stack_tpu/kv_offload \
         || fail=1
 else
     echo "== mypy not installed — skipping (pip install -e .[lint])"

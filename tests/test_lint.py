@@ -519,6 +519,597 @@ class TestFlagDrift:
         assert findings[0].file.endswith("router/parser.py")
 
 
+ENGINE_FILE = "production_stack_tpu/engine/mod.py"
+
+
+# ---------------------------------------------------------------------- PL007
+class TestUseAfterDonate:
+    RUNNER = """
+        import jax
+
+        class Runner:
+            def __init__(self):
+                self._decode = jax.jit(self._decode_impl,
+                                       donate_argnums=(1, 2))
+
+            def _decode_impl(self, params, kv_k, kv_v):
+                return kv_k + 1, kv_v + 1
+    """
+
+    def test_read_after_donate_fires(self, tmp_path):
+        _write(tmp_path, ENGINE_FILE, self.RUNNER + """
+            def bad(self, params):
+                toks, other = self._decode(params, self.kv_k, self.kv_v)
+                return self.kv_k.sum()
+        """)
+        findings = _lint(tmp_path, ENGINE_FILE)
+        assert _codes(findings) == ["PL007"]
+        assert "self.kv_k" in findings[0].message
+        assert "donated" in findings[0].message
+
+    def test_same_statement_rebind_is_clean(self, tmp_path):
+        _write(tmp_path, ENGINE_FILE, self.RUNNER + """
+            def good(self, params):
+                self.kv_k, self.kv_v = self._decode(
+                    params, self.kv_k, self.kv_v)
+                return self.kv_k.sum()
+        """)
+        assert _lint(tmp_path, ENGINE_FILE) == []
+
+    def test_later_rebind_clears_and_local_donation_tracked(self, tmp_path):
+        _write(tmp_path, ENGINE_FILE, self.RUNNER + """
+            def later(self, params, wk):
+                out = self._decode(params, self.kv_k, wk)
+                self.kv_k = out[0]
+                return self.kv_k.sum()
+
+            def local_read(self, params, wk):
+                out = self._decode(params, self.kv_k, wk)
+                self.kv_k = out[0]
+                return wk.sum()
+        """)
+        findings = _lint(tmp_path, ENGINE_FILE)
+        assert _codes(findings) == ["PL007"]
+        assert "wk" in findings[0].message
+        assert "local_read" not in findings[0].message  # anchors at the read
+        assert findings[0].render("github").startswith("::error file=")
+
+    def test_retry_guard_exempts_but_bare_except_does_not(self, tmp_path):
+        _write(tmp_path, ENGINE_FILE, self.RUNNER + """
+            def guarded(self, params):
+                out = self._decode(params, self.kv_k, self.kv_v)
+                try:
+                    return self.kv_k.sum()
+                except (RuntimeError, ValueError):
+                    return None
+        """)
+        assert _lint(tmp_path, ENGINE_FILE) == []
+        _write(tmp_path, ENGINE_FILE, self.RUNNER + """
+            def bare(self, params):
+                out = self._decode(params, self.kv_k, self.kv_v)
+                try:
+                    return self.kv_k.sum()
+                except Exception:
+                    raise
+        """)
+        assert _codes(_lint(tmp_path, ENGINE_FILE)) == ["PL007"]
+
+    def test_donate_argnames_spelling_also_fires(self, tmp_path):
+        # donate_argnames (names, not positions) resolves against the
+        # traced function's parameter list — the analyzer must not go
+        # silently blind on the keyword spelling.
+        _write(tmp_path, ENGINE_FILE, """
+            import jax
+
+            class Runner:
+                def __init__(self):
+                    self._decode = jax.jit(self._decode_impl,
+                                           donate_argnames=("kv_k", "kv_v"))
+
+                def _decode_impl(self, params, kv_k, kv_v):
+                    return kv_k + 1, kv_v + 1
+
+                def bad(self, params):
+                    toks, other = self._decode(params, self.kv_k, self.kv_v)
+                    return self.kv_k.sum()
+        """)
+        findings = _lint(tmp_path, ENGINE_FILE)
+        assert _codes(findings) == ["PL007"]
+        assert "self.kv_k" in findings[0].message
+
+    def test_factory_jit_binding_resolves(self, tmp_path):
+        _write(tmp_path, ENGINE_FILE, """
+            import jax
+
+            class Runner:
+                def __init__(self):
+                    self._reset = self._make_reset()
+
+                def _make_reset(self):
+                    def reset(pool):
+                        return pool * 0
+                    return jax.jit(reset, donate_argnums=(0,))
+
+                def clear(self):
+                    self._reset(self.pool)
+                    return self.pool.sum()
+        """)
+        findings = _lint(tmp_path, ENGINE_FILE)
+        assert _codes(findings) == ["PL007"]
+        assert "self.pool" in findings[0].message
+
+    def test_out_of_scope_package_not_checked(self, tmp_path):
+        rel = "production_stack_tpu/router/mod.py"
+        _write(tmp_path, rel, self.RUNNER + """
+            def bad(self, params):
+                toks, other = self._decode(params, self.kv_k, self.kv_v)
+                return self.kv_k.sum()
+        """)
+        assert "PL007" not in _codes(_lint(tmp_path, rel))
+
+
+# ---------------------------------------------------------------------- PL008
+class TestTraceHazards:
+    def test_item_in_jitted_fn_fires(self, tmp_path):
+        _write(tmp_path, ENGINE_FILE, """
+            import jax
+
+            @jax.jit
+            def step(x):
+                return x.item()
+        """)
+        findings = _lint(tmp_path, ENGINE_FILE)
+        assert _codes(findings) == ["PL008"]
+        assert ".item()" in findings[0].message
+
+    def test_item_in_scan_body_fires_via_chain(self, tmp_path):
+        _write(tmp_path, ENGINE_FILE, """
+            import jax
+
+            def run(xs):
+                def body(carry, x):
+                    carry = carry + _peek(x)
+                    return carry, x
+                return jax.lax.scan(body, 0, xs)
+
+            def _peek(x):
+                return x.item()
+        """)
+        findings = _lint(tmp_path, ENGINE_FILE)
+        assert _codes(findings) == ["PL008"]
+        assert "traced via" in findings[0].message
+
+    def test_branch_on_tracer_fires_static_and_meta_clean(self, tmp_path):
+        _write(tmp_path, ENGINE_FILE, """
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, static_argnames=("flag",))
+            def go(x, flag, win=None):
+                if flag:                    # static argname: clean
+                    x = x + 1
+                if x.shape[0] > 1:          # shape metadata: clean
+                    x = x + 2
+                if win is not None:         # optional-arg dispatch: clean
+                    x = x + win
+                if x > 0:                   # tracer branch: fires
+                    x = x + 3
+                return x
+        """)
+        findings = _lint(tmp_path, ENGINE_FILE)
+        assert _codes(findings) == ["PL008"]
+        assert "'x'" in findings[0].message
+
+    def test_varying_static_arg_at_call_site_fires(self, tmp_path):
+        _write(tmp_path, ENGINE_FILE, """
+            import time
+
+            import jax
+
+            class R:
+                def __init__(self):
+                    self._step = jax.jit(self._impl,
+                                         static_argnames=("n",))
+
+                def _impl(self, x, n):
+                    return x * n
+
+                def hot(self, x, n):
+                    return self._step(x, n=n)          # bucketed: clean
+
+                def churn(self, x):
+                    return self._step(x, n=time.time())  # fires
+        """)
+        findings = _lint(tmp_path, ENGINE_FILE)
+        assert _codes(findings) == ["PL008"]
+        assert "per-call-varying" in findings[0].message
+
+    def test_host_code_outside_trace_is_clean(self, tmp_path):
+        _write(tmp_path, ENGINE_FILE, """
+            import numpy as np
+
+            def read_blocks(pool, ids):
+                return np.asarray(pool)[ids].item()
+        """)
+        assert _lint(tmp_path, ENGINE_FILE) == []
+
+
+# ---------------------------------------------------------------------- PL009
+class TestSharedStateRace:
+    def test_rmw_across_await_fires(self, tmp_path):
+        _write(tmp_path, ROUTER_FILE, """
+            class Router:
+                async def bump(self):
+                    n = self.total
+                    await self.flush()
+                    self.total = n + 1
+        """)
+        findings = _lint(tmp_path, ROUTER_FILE)
+        assert _codes(findings) == ["PL009"]
+        assert "read before the await" in findings[0].message
+
+    def test_rmw_under_async_lock_is_clean(self, tmp_path):
+        _write(tmp_path, ROUTER_FILE, """
+            class Router:
+                async def bump(self):
+                    async with self._lock:
+                        n = self.total
+                        await self.flush()
+                        self.total = n + 1
+
+                async def no_await(self):
+                    n = self.total
+                    self.total = n + 1
+
+                async def unrelated_write(self, fresh):
+                    await self.flush()
+                    self.stats = fresh
+        """)
+        assert _lint(tmp_path, ROUTER_FILE) == []
+
+    def test_loop_body_accumulator_is_clean(self, tmp_path):
+        # Read and write are ADJACENT inside the loop body (the await
+        # comes after the write): the event loop cannot interleave between
+        # them, so no lost update — while the classic RMW-across-await
+        # inside a loop still fires.
+        _write(tmp_path, ROUTER_FILE, """
+            class Relay:
+                async def pump(self, stream):
+                    async for chunk in stream:
+                        self.bytes_sent = self.bytes_sent + len(chunk)
+                        await self.send(chunk)
+        """)
+        assert _lint(tmp_path, ROUTER_FILE) == []
+        _write(tmp_path, ROUTER_FILE, """
+            class Relay:
+                async def pump(self, stream):
+                    async for chunk in stream:
+                        n = self.bytes_sent
+                        await self.send(chunk)
+                        self.bytes_sent = n + len(chunk)
+        """)
+        assert _codes(_lint(tmp_path, ROUTER_FILE)) == ["PL009"]
+
+    def test_deferred_lambda_read_is_not_taint(self, tmp_path):
+        # A lambda reading self.x evaluates at CALL time, not where it is
+        # written — it must not taint the local as derived-from-self.x.
+        _write(tmp_path, ROUTER_FILE, """
+            class Relay:
+                async def go(self):
+                    cb = lambda: self.x
+                    await self.flush()
+                    self.x = self.compute(cb)
+        """)
+        assert _lint(tmp_path, ROUTER_FILE) == []
+
+    def test_cross_context_unlocked_mutation_fires(self, tmp_path):
+        _write(tmp_path, ROUTER_FILE, """
+            import threading
+
+            class Stats:
+                def start(self):
+                    self._thread = threading.Thread(
+                        target=self._worker, daemon=True)
+                    self._thread.start()
+
+                def _worker(self):
+                    self.passes += 1
+
+                def reset(self):
+                    with self._lock:
+                        self.passes = 0
+        """)
+        findings = _lint(tmp_path, ROUTER_FILE)
+        assert _codes(findings) == ["PL009"]
+        assert "self.passes" in findings[0].message
+        assert "without the lock" in findings[0].message
+
+    def test_atomic_swap_and_helper_under_lock_are_clean(self, tmp_path):
+        _write(tmp_path, ROUTER_FILE, """
+            import threading
+
+            class Stats:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.stats = {}
+                    self._load()          # ctor-only helper: clean
+
+                def _load(self):
+                    self.stats = {"boot": 1}
+
+                def start(self):
+                    self._thread = threading.Thread(
+                        target=self._worker, daemon=True)
+                    self._thread.start()
+
+                def _worker(self):
+                    fresh = {"x": 1}
+                    with self._lock:
+                        self.stats = fresh
+
+                def _store(self):
+                    self.stats = {}       # only ever called under the lock
+
+                def reset(self):
+                    with self._lock:
+                        self._store()
+        """)
+        assert _lint(tmp_path, ROUTER_FILE) == []
+
+
+# ---------------------------------------------------------------------- PL010
+class TestWireDrift:
+    def _registry(self, extra_formats=(), extra_ops=()):
+        from tools.pstpu_lint.wire_registry import FORMATS, OPS
+
+        return tuple(FORMATS) + tuple(extra_formats), \
+            tuple(OPS) + tuple(extra_ops)
+
+    def _tree(self, tmp_path, serde_extra=""):
+        for rel in ("production_stack_tpu/kv_offload/serde.py",
+                    "production_stack_tpu/kv_offload/remote.py",
+                    "production_stack_tpu/kv_offload/server.py"):
+            src = open(os.path.join(REPO, rel)).read()
+            _write(tmp_path, rel, src)
+        _write(tmp_path, "production_stack_tpu/disagg/transfer.py",
+               open(os.path.join(
+                   REPO, "production_stack_tpu/disagg/transfer.py")).read())
+        _write(tmp_path, "production_stack_tpu/kv_offload/manager.py",
+               'PREFIX = b"q8|"\n')
+        _write(tmp_path, "native/kv_server.cpp",
+               open(os.path.join(REPO, "native/kv_server.cpp")).read())
+        if serde_extra:
+            path = tmp_path / "production_stack_tpu/kv_offload/serde.py"
+            path.write_text(path.read_text() + textwrap.dedent(serde_extra))
+
+    def test_real_codecs_are_clean(self, tmp_path):
+        from tools.pstpu_lint.rules.wire_drift import check_wire
+
+        self._tree(tmp_path)
+        assert check_wire(str(tmp_path), docs_check=False) == []
+
+    def test_encoder_without_decoder_fires(self, tmp_path):
+        from tools.pstpu_lint.rules.wire_drift import check_wire
+        from tools.pstpu_lint.wire_registry import WireFormat
+
+        self._tree(tmp_path, serde_extra="""
+            _MAGIC_V3 = b"PKV3"
+
+
+            def pack_block_v3(k, v):
+                return struct.pack("<4s", _MAGIC_V3) + k.tobytes()
+        """)
+        formats, ops = self._registry(extra_formats=(
+            WireFormat("PKV3", "kv-block", 3, "PKV2", False, "doc"),))
+        findings = check_wire(str(tmp_path), registry_formats=formats,
+                              registry_ops=ops, docs_check=False)
+        assert [f.rule for f in findings] == ["PL010"]
+        assert "no decoder" in findings[0].message
+        assert findings[0].file.endswith("serde.py")
+
+    def test_membership_test_counts_as_decoder(self, tmp_path):
+        # A decoder spelled as a tuple-membership test is still a decoder.
+        from tools.pstpu_lint.rules.wire_drift import check_wire
+        from tools.pstpu_lint.wire_registry import WireFormat
+
+        self._tree(tmp_path, serde_extra="""
+            _MAGIC_V4 = b"PKV4"
+
+
+            def pack_block_v4(k):
+                return _MAGIC_V4 + k.tobytes()
+
+
+            def sniff(blob):
+                return blob[:4] in (_MAGIC_V4, b"PKV1")
+        """)
+        formats, ops = self._registry(extra_formats=(
+            WireFormat("PKV4", "kv-block", 4, "PKV2", False, "doc"),))
+        assert check_wire(str(tmp_path), registry_formats=formats,
+                          registry_ops=ops, docs_check=False) == []
+
+    def test_unregistered_magic_fires(self, tmp_path):
+        from tools.pstpu_lint.rules.wire_drift import check_wire
+
+        self._tree(tmp_path, serde_extra="""
+            _MAGIC_V9 = b"PKV9"
+
+
+            def unpack_block_v9(blob):
+                if blob[:4] != _MAGIC_V9:
+                    raise ValueError("nope")
+                return blob[4:]
+
+
+            def pack_block_v9(k):
+                return _MAGIC_V9 + k.tobytes()
+        """)
+        findings = check_wire(str(tmp_path), docs_check=False)
+        assert [f.rule for f in findings] == ["PL010"]
+        assert "not in the wire registry" in findings[0].message
+
+    def test_retired_format_with_encoder_fires(self, tmp_path):
+        from tools.pstpu_lint.rules.wire_drift import check_wire
+        from tools.pstpu_lint.wire_registry import FORMATS, OPS, WireFormat
+
+        self._tree(tmp_path)
+        formats = tuple(
+            WireFormat(f.magic, f.family, f.version, f.supersedes,
+                       True if f.magic == "PKV1" else f.retired, f.doc)
+            for f in FORMATS
+        )
+        findings = check_wire(str(tmp_path), registry_formats=formats,
+                              registry_ops=OPS, docs_check=False)
+        assert any("retired" in f.message and "encoder" in f.message
+                   for f in findings)
+
+    def test_client_op_without_server_dispatch_fires(self, tmp_path):
+        from tools.pstpu_lint.rules.wire_drift import check_wire
+        from tools.pstpu_lint.wire_registry import WireOp
+
+        self._tree(tmp_path)
+        path = tmp_path / "production_stack_tpu/kv_offload/remote.py"
+        path.write_text(path.read_text() + textwrap.dedent("""
+
+            def flush(client):
+                status, _ = client._request(b"F", b"")
+                return status
+        """))
+        _formats, ops = self._registry(extra_ops=(
+            WireOp("F", "flush", False, True, False, "doc"),))
+        findings = check_wire(str(tmp_path), registry_ops=ops,
+                              docs_check=False)
+        assert [f.rule for f in findings] == ["PL010"]
+        assert "never dispatches" in findings[0].message
+
+    def test_native_coverage_mismatch_fires(self, tmp_path):
+        from tools.pstpu_lint.rules.wire_drift import check_wire
+        from tools.pstpu_lint.wire_registry import FORMATS, WireOp, OPS
+
+        self._tree(tmp_path)
+        ops = tuple(
+            WireOp(o.op, o.name, o.batched, o.mutates,
+                   True if o.op == "M" else o.native, o.doc)
+            for o in OPS
+        )
+        findings = check_wire(str(tmp_path), registry_formats=FORMATS,
+                              registry_ops=ops, docs_check=False)
+        assert [f.rule for f in findings] == ["PL010"]
+        assert "native" in findings[0].message
+
+
+# ------------------------------------------------------------ PL006 helm leg
+class TestHelmDrift:
+    def _chart(self, tmp_path, flag="--num-decode-steps",
+               schema_keys=("numDecodeSteps",),
+               template_keys=("numDecodeSteps",)):
+        _write(tmp_path, "production_stack_tpu/server/api_server.py", """
+            import argparse
+
+            def parse_args():
+                p = argparse.ArgumentParser()
+                p.add_argument("--num-decode-steps", type=int, default=8,
+                               help="fused decode steps")
+                return p.parse_args()
+
+            def main(args):
+                print(args.num_decode_steps)
+        """)
+        _write(tmp_path, "production_stack_tpu/router/parser.py", """
+            import argparse
+
+            def parse_args():
+                p = argparse.ArgumentParser()
+                p.add_argument("--routing-logic", default="roundrobin",
+                               help="routing policy")
+                return p.parse_args()
+        """)
+        args = "\n".join(
+            f'            - "{flag}"\n'
+            f"            - {{{{ $modelSpec.tpuConfig.{k} | quote }}}}"
+            for k in template_keys
+        )
+        _write(tmp_path, "helm/templates/deployment-engine.yaml",
+               "spec:\n  template:\n    spec:\n      containers:\n"
+               "        - args:\n" + args + "\n")
+        import json as _json
+
+        schema = {
+            "properties": {
+                "servingEngineSpec": {"properties": {"modelSpec": {
+                    "items": {"properties": {"tpuConfig": {
+                        "properties": {k: {} for k in schema_keys}
+                    }}}
+                }}},
+                "routerSpec": {"properties": {}},
+            }
+        }
+        _write(tmp_path, "helm/values.schema.json", _json.dumps(schema))
+        _write(tmp_path, "helm/values.yaml", "servingEngineSpec:\n")
+
+    def test_clean_chart_passes(self, tmp_path):
+        from tools.pstpu_lint.rules.flag_drift import check_helm
+
+        self._chart(tmp_path)
+        assert check_helm(str(tmp_path)) == []
+
+    def test_dead_helm_knob_fires(self, tmp_path):
+        # The template renders a flag the engine parser does not define.
+        from tools.pstpu_lint.rules.flag_drift import check_helm
+
+        self._chart(tmp_path, flag="--num-decode-stepz")
+        findings = check_helm(str(tmp_path))
+        assert [f.rule for f in findings] == ["PL006"]
+        assert "--num-decode-stepz" in findings[0].message
+        assert "does not exist" in findings[0].message
+        assert findings[0].file.endswith("deployment-engine.yaml")
+
+    def test_key_missing_from_schema_fires(self, tmp_path):
+        from tools.pstpu_lint.rules.flag_drift import check_helm
+
+        self._chart(tmp_path, schema_keys=())
+        findings = check_helm(str(tmp_path))
+        assert [f.rule for f in findings] == ["PL006"]
+        assert "not declared" in findings[0].message
+
+    def test_schema_key_no_template_consumes_fires(self, tmp_path):
+        from tools.pstpu_lint.rules.flag_drift import check_helm
+
+        self._chart(tmp_path,
+                    schema_keys=("numDecodeSteps", "ghostKnob"))
+        findings = check_helm(str(tmp_path))
+        assert [f.rule for f in findings] == ["PL006"]
+        assert "ghostKnob" in findings[0].message
+        assert "no template" in findings[0].message
+
+    def test_values_key_missing_from_schema_fires(self, tmp_path):
+        from tools.pstpu_lint.rules.flag_drift import check_helm
+
+        self._chart(tmp_path)
+        _write(tmp_path, "helm/values.yaml", """
+            routerSpec:
+              routingLogic: "roundrobin"
+        """)
+        findings = check_helm(str(tmp_path))
+        assert [f.rule for f in findings] == ["PL006"]
+        assert "routerSpec.routingLogic" in findings[0].message
+        assert "missing from" in findings[0].message
+
+    def test_live_chart_is_covered(self):
+        # The real chart parses and the scanner finds the known wirings —
+        # guards the regexes against template drift.
+        from tools.pstpu_lint.flags import scan_helm_wirings
+
+        with open(os.path.join(
+                REPO, "helm/templates/deployment-engine.yaml")) as f:
+            wirings = scan_helm_wirings(f.read())
+        by_key = {w.key: w.flag for w in wirings if w.section == "tpuConfig"}
+        assert by_key.get("tensorParallelSize") == "--tensor-parallel-size"
+        assert by_key.get("kvCacheDtype") == "--kv-cache-dtype"
+        # accelerator is nodeSelector wiring, not a flag
+        assert by_key.get("accelerator") is None
+
+
 # -------------------------------------------------------------------- waivers
 class TestWaivers:
     def test_waiver_with_reason_suppresses(self, tmp_path):
@@ -581,6 +1172,42 @@ class TestWaivers:
         assert w.reason == "why not"
         assert w.anchor_line == 1
 
+    def test_unknown_rule_code_is_pl000(self, tmp_path):
+        # A waiver naming a rule that does not exist (typo, or a code left
+        # behind by a rename) is an error, not a silent no-op — and it is
+        # NOT double-reported as stale.
+        _write(tmp_path, ROUTER_FILE, """
+            x = 1  # pstpu-lint: allow[PL999] reason=renamed long ago
+        """)
+        findings = _lint(tmp_path, ROUTER_FILE)
+        assert _codes(findings) == ["PL000"]
+        assert "unknown rule" in findings[0].message
+        assert "PL999" in findings[0].message
+
+    def test_known_plus_unknown_rule_mix(self, tmp_path):
+        # The known half still suppresses; only the unknown half errors.
+        _write(tmp_path, ROUTER_FILE, """
+            import time
+
+            async def handler(request):
+                time.sleep(0.01)  # pstpu-lint: allow[PL001,PL998] reason=x
+        """)
+        findings = _lint(tmp_path, ROUTER_FILE)
+        assert _codes(findings) == ["PL000"]
+        assert "allow[PL998]" in findings[0].message
+        assert "unknown rule" in findings[0].message
+
+    def test_new_rule_codes_are_waivable(self, tmp_path):
+        # The PL007-PL010 codes ride the same PL000 machinery.
+        _write(tmp_path, ENGINE_FILE, """
+            import jax
+
+            @jax.jit
+            def step(x):
+                return x.item()  # pstpu-lint: allow[PL008] reason=debug shim
+        """)
+        assert _lint(tmp_path, ENGINE_FILE) == []
+
 
 # ------------------------------------------------------------------ reporting
 class TestReporting:
@@ -635,12 +1262,36 @@ class TestLiveRepo:
         assert findings == [], "\n".join(f.render() for f in findings)
 
     def test_docs_tables_are_fresh(self):
-        """docs/METRICS.md + the focused tables + README flag tables match
-        the registries (regenerate with python -m tools.pstpu_lint.gen_docs)."""
-        from tools.pstpu_lint.gen_docs import check_flag_tables, check_tables
+        """docs/METRICS.md + the focused tables + README flag tables +
+        docs/WIRE_FORMATS.md match the registries (regenerate with
+        python -m tools.pstpu_lint.gen_docs)."""
+        from tools.pstpu_lint.gen_docs import (
+            check_flag_tables,
+            check_tables,
+            check_wire_tables,
+        )
 
         assert check_tables(REPO) == []
         assert check_flag_tables(REPO) == []
+        assert check_wire_tables(REPO) == []
+
+    def test_stale_wire_table_fails_pl010(self, tmp_path):
+        """The PL010 docs-freshness gate, PL004-style: a WIRE_FORMATS.md
+        whose table no longer matches the registry is a finding."""
+        import shutil
+
+        from tools.pstpu_lint.rules.wire_drift import check_wire
+
+        for rel in ("production_stack_tpu/kv_offload",
+                    "production_stack_tpu/disagg", "native"):
+            shutil.copytree(os.path.join(REPO, rel), tmp_path / rel)
+        docs = open(os.path.join(REPO, "docs/WIRE_FORMATS.md")).read()
+        _write(tmp_path, "docs/WIRE_FORMATS.md",
+               docs.replace("| `PKV2` |", "| `PKV9` |"))
+        findings = check_wire(str(tmp_path))
+        assert [f.rule for f in findings] == ["PL010"]
+        assert "out of date" in findings[0].message
+        assert findings[0].file == "docs/WIRE_FORMATS.md"
 
     def test_deliberate_violation_fails(self, tmp_path):
         """The CI acceptance probe: introducing a time.sleep in an async
@@ -655,3 +1306,130 @@ class TestLiveRepo:
                             project_rules=False)
         assert [f.rule for f in findings] == ["PL001"]
         assert findings[0].line == 5
+
+
+class TestLiveRepoInjections:
+    """The four acceptance probes: each hazard injected into a COPY of the
+    real source must fail the suite with a correct file/line github
+    annotation. These guard the analyzers themselves — a rule that
+    silently stops firing on the real tree's idioms fails here."""
+
+    def _copy(self, tmp_path, rel):
+        src = open(os.path.join(REPO, rel)).read()
+        return src, tmp_path / rel
+
+    def _annotations(self, findings):
+        return [f.render("github") for f in findings]
+
+    def test_use_after_donate_in_runner(self, tmp_path):
+        """(a) a read of a donated pool binding after the decode dispatch
+        in runner.py fires PL007 at the injected line."""
+        rel = "production_stack_tpu/engine/runner.py"
+        src, _path = self._copy(tmp_path, rel)
+        needle = ("        self._rebind_scale_pools(kv_ks2, kv_vs2)\n"
+                  "        self._rebind_spec_pools(sp_k2, sp_v2, sp_p2)\n"
+                  "        if self.kv_quantized:")
+        assert src.count(needle) >= 1, "decode dispatch idiom moved"
+        injected = needle.replace(
+            "        if self.kv_quantized:",
+            "        stale = wk.sum()  # injected use-after-donate\n"
+            "        if self.kv_quantized:")
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(src.replace(needle, injected, 1))
+        line = src[:src.index(needle)].count("\n") + 3
+        findings = run_lint([str(path)], project_root=str(tmp_path),
+                            project_rules=False)
+        assert [f.rule for f in findings] == ["PL007"]
+        assert findings[0].line == line
+        ann = self._annotations(findings)[0]
+        assert ann.startswith(f"::error file={rel},line={line},")
+        assert "PL007" in ann
+
+        # Control: the unmodified runner.py is clean (the rebind idiom is
+        # the checked contract, not a waiver).
+        path.write_text(src)
+        assert run_lint([str(path)], project_root=str(tmp_path),
+                        project_rules=False) == []
+
+    def test_item_in_fused_decode_scan(self, tmp_path):
+        """(b) an .item() inside the fused decode scan body fires PL008."""
+        rel = "production_stack_tpu/engine/runner.py"
+        src, _ = self._copy(tmp_path, rel)
+        needle = ("            def scan_body(carry, j):\n"
+                  "                carry, nxt, lp = body(carry, j)\n")
+        assert src.count(needle) == 1, "fused decode scan body moved"
+        injected = needle + \
+            "                probe = nxt.item()  # injected host sync\n"
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(src.replace(needle, injected))
+        line = src[:src.index(needle)].count("\n") + 3
+        findings = run_lint([str(path)], project_root=str(tmp_path),
+                            project_rules=False)
+        assert [f.rule for f in findings] == ["PL008"]
+        assert findings[0].line == line
+        assert ".item()" in findings[0].message
+        ann = self._annotations(findings)[0]
+        assert ann.startswith(f"::error file={rel},line={line},")
+
+    def test_unlocked_counter_in_engine_stats(self, tmp_path):
+        """(c) an unlocked cross-thread mutation of scraper state in
+        engine_stats.py fires PL009."""
+        rel = "production_stack_tpu/router/stats/engine_stats.py"
+        src, _ = self._copy(tmp_path, rel)
+        needle = "        live = {ep.url for ep in endpoints}\n"
+        assert src.count(needle) == 1, "scrape pass shape moved"
+        injected = needle + ("        self.engine_stats[\"__passes__\"] = "
+                             "EngineStats()  # injected unlocked\n")
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(src.replace(needle, injected))
+        line = src[:src.index(needle)].count("\n") + 2
+        findings = run_lint([str(path)], project_root=str(tmp_path),
+                            project_rules=False)
+        assert [f.rule for f in findings] == ["PL009"]
+        assert findings[0].line == line
+        assert "without the lock" in findings[0].message
+        ann = self._annotations(findings)[0]
+        assert ann.startswith(f"::error file={rel},line={line},")
+
+        path.write_text(src)
+        assert run_lint([str(path)], project_root=str(tmp_path),
+                        project_rules=False) == []
+
+    def test_pkv3_encoder_without_decoder(self, tmp_path):
+        """(d) a new PKV3 encoder with no decoder fires PL010 at the
+        encoder site in serde.py."""
+        import shutil
+
+        from tools.pstpu_lint.rules.wire_drift import check_wire
+
+        for rel in ("production_stack_tpu/kv_offload",
+                    "production_stack_tpu/disagg", "native"):
+            shutil.copytree(os.path.join(REPO, rel), tmp_path / rel)
+        serde = tmp_path / "production_stack_tpu/kv_offload/serde.py"
+        src = serde.read_text()
+        serde.write_text(src + textwrap.dedent("""
+
+            _MAGIC_V3 = b"PKV3"
+
+
+            def pack_block_v3(k, v):
+                return struct.pack("<4s", _MAGIC_V3) + k.tobytes()
+        """))
+        findings = check_wire(str(tmp_path), docs_check=False)
+        rules = sorted({f.rule for f in findings})
+        assert rules == ["PL010"]
+        msgs = " | ".join(f.message for f in findings)
+        assert "PKV3" in msgs
+        assert "no decoder" in msgs
+        rel = "production_stack_tpu/kv_offload/serde.py"
+        assert all(f.file == rel for f in findings)
+        assert all(f.line > src.count("\n") for f in findings)
+        ann = self._annotations(findings)[0]
+        assert ann.startswith(f"::error file={rel},line=")
+
+        # Control: the pristine copy is clean.
+        serde.write_text(src)
+        assert check_wire(str(tmp_path), docs_check=False) == []

@@ -213,6 +213,9 @@ def run_lint(
             surviving.append(f)
 
     # ------------------------------------------------------- waiver hygiene
+    known_rules = {"PL000"}
+    known_rules.update(code for code, _s, _f in rules_mod.FILE_RULES)
+    known_rules.update(code for code, _w, _f in rules_mod.PROJECT_RULES)
     for w in waivers:
         if not w.reason:
             surviving.append(Finding(
@@ -220,7 +223,18 @@ def run_lint(
                 f"waiver allow[{','.join(w.rules)}] has no reason= "
                 f"justification",
             ))
-        stale = [r for r in w.rules if r not in w.used]
+        # A waiver naming a rule that does not exist (typo, or a code left
+        # behind by a rename) would otherwise sit forever looking
+        # load-bearing while suppressing nothing.
+        unknown = [r for r in w.rules if r not in known_rules]
+        if unknown:
+            surviving.append(Finding(
+                "PL000", w.file, w.comment_line,
+                f"waiver allow[{','.join(unknown)}] names unknown rule "
+                f"code(s) — known: {', '.join(sorted(known_rules))}",
+            ))
+        stale = [r for r in w.rules
+                 if r not in w.used and r not in unknown]
         if stale and w.reason:
             surviving.append(Finding(
                 "PL000", w.file, w.comment_line,

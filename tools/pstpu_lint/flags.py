@@ -3,11 +3,18 @@
 Walks ``add_argument`` calls with a constant ``--flag`` first argument and
 records the option string, dest, rendered default, and help text. Defaults
 that are not literals (e.g. ``os.environ.get(...)``) render as ``env``.
+
+Also scans the helm chart (stdlib-only, regex over the template text) for
+``tpuConfig.*``/``routerSpec.*`` value references and the ``--flag`` each
+one renders next to, plus the key sets declared in ``values.yaml`` and
+``values.schema.json`` — the inputs of PL006's helm-drift leg.
 """
 
 import ast
+import json
+import re
 from dataclasses import dataclass
-from typing import List, Optional
+from typing import Dict, List, Optional, Set
 
 
 @dataclass
@@ -76,3 +83,85 @@ def scan_flags(source: str) -> List[Flag]:
             help=" ".join(help_text.split()), line=node.lineno,
         ))
     return flags
+
+
+# ------------------------------------------------------------- helm chart
+@dataclass
+class HelmWiring:
+    """One ``tpuConfig.X``/``routerSpec.Y`` value reference in a template,
+    with the ``--flag`` it renders next to (None = non-flag use: image
+    fields, labels, nodeSelector, probes...)."""
+
+    section: str          # "tpuConfig" | "routerSpec"
+    key: str              # "tensorParallelSize"
+    flag: Optional[str]   # "--tensor-parallel-size" or None
+    line: int
+
+    @property
+    def dotted(self) -> str:
+        return f"{self.section}.{self.key}"
+
+
+_HELM_KEY_RE = re.compile(r"\b(tpuConfig|routerSpec)\.(\w+)")
+_HELM_FLAG_RE = re.compile(r'"(--[a-z][a-z0-9-]*)"')
+
+
+def scan_helm_wirings(template_source: str) -> List[HelmWiring]:
+    """Pair every tpuConfig./routerSpec. reference with the CLI flag
+    rendered within two lines of it (helm args lists put the flag literal
+    on the line above its value; ``if not X`` negations put it below)."""
+    lines = template_source.splitlines()
+    out: List[HelmWiring] = []
+    for i, line in enumerate(lines):
+        for m in _HELM_KEY_RE.finditer(line):
+            flag = None
+            for dj in (0, -1, 1, -2, 2):   # nearest line first
+                j = i + dj
+                if 0 <= j < len(lines):
+                    fm = _HELM_FLAG_RE.search(lines[j])
+                    if fm:
+                        flag = fm.group(1)
+                        break
+            out.append(HelmWiring(m.group(1), m.group(2), flag, i + 1))
+    return out
+
+
+def scan_helm_schema_keys(schema_source: str) -> Dict[str, Set[str]]:
+    """{'tpuConfig': {...}, 'routerSpec': {...}} property-name sets from
+    values.schema.json."""
+    schema = json.loads(schema_source)
+    out: Dict[str, Set[str]] = {"tpuConfig": set(), "routerSpec": set()}
+    try:
+        tpu = (schema["properties"]["servingEngineSpec"]["properties"]
+               ["modelSpec"]["items"]["properties"]["tpuConfig"]
+               ["properties"])
+        out["tpuConfig"] = set(tpu)
+    except KeyError:
+        pass
+    try:
+        out["routerSpec"] = set(
+            schema["properties"]["routerSpec"]["properties"])
+    except KeyError:
+        pass
+    return out
+
+
+def scan_helm_values_keys(values_source: str) -> Dict[str, Set[str]]:
+    """Top-level key names under the ``routerSpec:`` mapping in
+    values.yaml (two-space indent; comments skipped). tpuConfig carries no
+    defaults in values.yaml (modelSpec is an empty list), so only
+    routerSpec is scanned."""
+    out: Dict[str, Set[str]] = {"routerSpec": set()}
+    in_section = False
+    for line in values_source.splitlines():
+        if re.match(r"^routerSpec:\s*$", line):
+            in_section = True
+            continue
+        if in_section:
+            if line.strip() and not line.startswith(" ") \
+                    and not line.lstrip().startswith("#"):
+                break   # next top-level key ends the section
+            m = re.match(r"^  (\w+):", line)
+            if m:
+                out["routerSpec"].add(m.group(1))
+    return out

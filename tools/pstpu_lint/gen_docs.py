@@ -3,14 +3,16 @@ single-source registries, inside marker comments:
 
     <!-- pstpu-metrics:BEGIN <group> -->  ...  <!-- pstpu-metrics:END <group> -->
     <!-- pstpu-flags:BEGIN <tier> -->     ...  <!-- pstpu-flags:END <tier> -->
+    <!-- pstpu-wire:BEGIN <group> -->     ...  <!-- pstpu-wire:END <group> -->
 
 Write mode refreshes the delimited blocks in place; ``--check`` reports
 stale/missing blocks without writing (the PL004 rule runs the metrics half
-of the check on every lint). Sources of truth:
+of the check on every lint; PL010 the wire half). Sources of truth:
 
   * series: tools/pstpu_lint/metrics_registry.py
   * flags:  the argparse definitions in router/parser.py and
             server/api_server.py (tools/pstpu_lint/flags.py scans them)
+  * wire:   tools/pstpu_lint/wire_registry.py (docs/WIRE_FORMATS.md)
 
 Usage: ``python -m tools.pstpu_lint.gen_docs [--check]``.
 """
@@ -41,6 +43,13 @@ TABLES = {
 FLAG_TABLES = {
     "router": ("README.md", "production_stack_tpu/router/parser.py"),
     "engine": ("README.md", "production_stack_tpu/server/api_server.py"),
+}
+
+# wire table group -> file carrying its marker block (PL010's freshness
+# gate, same contract as the PL004 metrics tables above).
+WIRE_TABLES = {
+    "formats": "docs/WIRE_FORMATS.md",
+    "ops": "docs/WIRE_FORMATS.md",
 }
 
 _SURFACE_NAMES = {
@@ -86,6 +95,37 @@ def render_flags_table(parser_source: str) -> str:
     return "\n".join(lines)
 
 
+def render_wire_table(group: str, formats=None, ops=None) -> str:
+    from tools.pstpu_lint import wire_registry as wreg
+
+    formats = wreg.FORMATS if formats is None else formats
+    ops = wreg.OPS if ops is None else ops
+    if group == "formats":
+        lines = [
+            "| Magic | Family | Version | Supersedes | Status | Meaning |",
+            "|---|---|---|---|---|---|",
+        ]
+        for f in formats:
+            status = "retired" if f.retired else "current"
+            lines.append(
+                f"| `{f.magic}` | {f.family} | v{f.version} "
+                f"| {f.supersedes or '—'} | {status} | {_cell(f.doc)} |"
+            )
+        return "\n".join(lines)
+    lines = [
+        "| Op | Name | Batched | Mutates | Native server | Meaning |",
+        "|---|---|---|---|---|---|",
+    ]
+    for o in ops:
+        native = "yes" if o.native else "no (STATUS_ERROR; client degrades)"
+        lines.append(
+            f"| `{o.op}` | {o.name} | {'yes' if o.batched else 'no'} "
+            f"| {'yes' if o.mutates else 'no'} | {native} "
+            f"| {_cell(o.doc)} |"
+        )
+    return "\n".join(lines)
+
+
 def _block_re(kind: str, group: str) -> re.Pattern:
     return re.compile(
         rf"(<!-- pstpu-{kind}:BEGIN {re.escape(group)} -->)\n"
@@ -107,7 +147,8 @@ def _update_block(text: str, kind: str, group: str,
     )
 
 
-def _iter_blocks(project_root: str, registry=None, kinds=None):
+def _iter_blocks(project_root: str, registry=None, kinds=None,
+                 wire_registries=None):
     """Every generated block as (kind, group, relpath, path, table-or-None);
     table is None when an input file is missing. ``kinds`` restricts which
     table families are rendered (PL004 checks only the metrics tables,
@@ -127,11 +168,18 @@ def _iter_blocks(project_root: str, registry=None, kinds=None):
                 with open(parser_path, encoding="utf-8") as f:
                     table = render_flags_table(f.read())
             yield "flags", tier, relpath, path, table
+    if kinds is None or "wire" in kinds:
+        for group, relpath in WIRE_TABLES.items():
+            path = os.path.join(project_root, relpath)
+            table = (render_wire_table(group, **(wire_registries or {}))
+                     if os.path.exists(path) else None)
+            yield "wire", group, relpath, path, table
 
 
 def _sync_blocks(project_root: str, registry=None,
                  write: bool = False,
-                 kinds=None) -> List[Tuple[str, str, str]]:
+                 kinds=None,
+                 wire_registries=None) -> List[Tuple[str, str, str]]:
     """One pass over every block. write=False: report (group, relpath,
     problem) per stale/missing block. write=True: refresh stale blocks in
     place and report (group, relpath, "updated") per file written —
@@ -139,7 +187,7 @@ def _sync_blocks(project_root: str, registry=None,
     ``gen_docs`` and ``gen_docs --check`` can never disagree on a tree."""
     out = []
     for kind, group, relpath, path, table in _iter_blocks(
-        project_root, registry, kinds
+        project_root, registry, kinds, wire_registries
     ):
         if table is None:
             out.append((group, relpath, "missing (file not found)"))
@@ -169,6 +217,16 @@ def check_flag_tables(project_root: str) -> List[Tuple[str, str, str]]:
     return _sync_blocks(project_root, kinds={"flags"})
 
 
+def check_wire_tables(project_root: str, formats=None,
+                      ops=None) -> List[Tuple[str, str, str]]:
+    """(group, relpath, problem) for every stale/missing wire block
+    (the PL010 docs-freshness gate)."""
+    wire = None
+    if formats is not None or ops is not None:
+        wire = {"formats": formats, "ops": ops}
+    return _sync_blocks(project_root, kinds={"wire"}, wire_registries=wire)
+
+
 def write_tables(project_root: str) -> List[str]:
     """Refresh every block in place; returns the files touched (and raises
     nothing on missing files — they surface via --check / PL004)."""
@@ -188,7 +246,8 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     root = os.path.abspath(args.project_root)
     if args.check:
-        problems = check_tables(root) + check_flag_tables(root)
+        problems = (check_tables(root) + check_flag_tables(root)
+                    + check_wire_tables(root))
         for group, relpath, what in problems:
             print(f"{relpath}: table {group!r} is {what}", file=sys.stderr)
         return 1 if problems else 0

@@ -1,0 +1,344 @@
+"""Module-local model of JAX dispatch surfaces, shared by PL007/PL008.
+
+Two things the donation and trace-safety rules both need:
+
+  * which bindings in a module hold a jitted callable, and with what
+    ``donate_argnums`` / ``static_argnames`` (the *dispatch signature*) —
+    from direct assignments (``self._decode = jax.jit(self._decode_impl,
+    donate_argnums=...)``), decorated defs (``@jax.jit`` /
+    ``@partial(jax.jit, ...)``), and one level of factory indirection
+    (``self._reset = self._make_reset()`` where ``_make_reset`` returns a
+    ``jax.jit(...)``);
+  * which function bodies are *traced* — the callables handed to
+    ``jax.jit``/``pjit``/``jax.lax.scan``/``while_loop``/``cond``/
+    ``fori_loop``/``shard_map``/``vmap``, plus everything they call per the
+    module-local call graph (tools/pstpu_lint/callgraph.py).
+
+Resolution is module-local by design, matching the rest of the suite: the
+repo's dispatch wrappers and their call sites live in the same module
+(engine/runner.py), and cross-module jit handoff would be a smell the
+human reviewer should see anyway.
+"""
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from tools.pstpu_lint.callgraph import CallGraph
+
+# Transform entry points that take a callable first argument and trace it.
+_TRACERS = {
+    "jit", "pjit", "vmap", "grad", "value_and_grad", "checkpoint", "remat",
+}
+_LAX_TRACERS = {"scan", "while_loop", "cond", "fori_loop", "map",
+                "associated_scan", "associative_scan", "switch"}
+_SHARD_TRACERS = {"shard_map"}
+
+
+@dataclass
+class JitBinding:
+    """One binding that holds a jitted callable."""
+
+    key: str                     # "self._decode" or a bare name
+    impl_qual: Optional[str]     # module-local qualname of the traced fn
+    donate: Tuple[int, ...]      # donate_argnums (positional, call-site)
+    static_names: Tuple[str, ...]  # static_argnames
+    line: int
+
+
+@dataclass
+class JaxModel:
+    graph: CallGraph
+    bindings: Dict[str, JitBinding] = field(default_factory=dict)
+    # qualnames of function bodies that are traced entry points, with the
+    # static-argname set that applies to their parameters ("" entries for
+    # scan/cond/shard_map bodies, where every parameter is tracer-typed).
+    seeds: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
+
+    def traced_context(self) -> Dict[str, List[str]]:
+        """qualname -> caller chain from a traced seed (the seed maps to a
+        one-element chain), via plain module-local calls."""
+        chains: Dict[str, List[str]] = {}
+        frontier = []
+        for qual in self.seeds:
+            chains[qual] = [qual]
+            frontier.append(qual)
+        while frontier:
+            qual = frontier.pop()
+            info = self.graph.functions.get(qual)
+            if info is None:
+                continue
+            for callee, _line in info.calls:
+                if callee in chains:
+                    continue
+                chains[callee] = chains[qual] + [callee]
+                frontier.append(callee)
+        return chains
+
+
+def _const_int_tuple(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def _const_str_tuple(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append(e.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def _callable_name(fn: ast.AST) -> str:
+    """'jit' for jax.jit / pjit / bare jit, 'scan' for jax.lax.scan, ..."""
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return ""
+
+
+def _is_tracer_call(node: ast.Call) -> str:
+    """'' unless this call traces its first argument; else the kind
+    ('jit' | 'lax' | 'shard')."""
+    name = _callable_name(node.func)
+    if name in _TRACERS:
+        return "jit"
+    if name in _SHARD_TRACERS:
+        return "shard"
+    if name in _LAX_TRACERS:
+        # Guard against domain methods named scan/map: require a
+        # jax/lax-ish receiver (jax.lax.scan, lax.scan) or bare name.
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            root = fn.value
+            rootname = (
+                root.attr if isinstance(root, ast.Attribute)
+                else root.id if isinstance(root, ast.Name) else ""
+            )
+            if rootname not in ("lax", "jax"):
+                return ""
+        return "lax"
+    return ""
+
+
+def _jit_signature(node: ast.Call):
+    """(donate_nums, donate_names, static_names) from a jit/pjit call's
+    keywords. ``donate_argnames`` entries are resolved to positions later,
+    against the traced function's parameter list."""
+    donate: Tuple[int, ...] = ()
+    donate_names: Tuple[str, ...] = ()
+    static: Tuple[str, ...] = ()
+    for kw in node.keywords:
+        if kw.arg == "donate_argnums":
+            donate = _const_int_tuple(kw.value) or ()
+        elif kw.arg == "donate_argnames":
+            donate_names = _const_str_tuple(kw.value) or ()
+        elif kw.arg in ("static_argnames",):
+            static = _const_str_tuple(kw.value) or ()
+    return donate, donate_names, static
+
+
+def _donate_positions(graph: CallGraph, impl_qual: Optional[str],
+                      nums: Tuple[int, ...],
+                      names: Tuple[str, ...]) -> Tuple[int, ...]:
+    """Positional donate set: explicit argnums plus argnames resolved
+    against the traced function's parameters (self/cls excluded, matching
+    the call-site positional layout of a bound-method jit)."""
+    out = list(nums)
+    if names and impl_qual is not None:
+        info = graph.functions.get(impl_qual)
+        if info is not None:
+            args = info.node.args
+            params = [a.arg for a in args.posonlyargs + args.args
+                      if a.arg not in ("self", "cls")]
+            for name in names:
+                if name in params:
+                    out.append(params.index(name))
+    return tuple(sorted(set(out)))
+
+
+def _binding_key(target: ast.AST) -> Optional[str]:
+    if isinstance(target, ast.Name):
+        return target.id
+    if (isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id in ("self", "cls")):
+        return f"self.{target.attr}"
+    return None
+
+
+def _resolve_callable(graph: CallGraph, owner_qual: str,
+                      node: ast.AST) -> Optional[str]:
+    """Module-local qualname of a callable expression (Name or
+    self.method), resolved from the function whose body contains it."""
+    info = graph.functions.get(owner_qual)
+    if info is None:
+        return None
+    if isinstance(node, ast.Name):
+        return graph._resolve_name(info, node.id)
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id in ("self", "cls")):
+        return graph._resolve_self_method(info, node.attr)
+    return None
+
+
+def build(tree: ast.AST) -> JaxModel:
+    graph = CallGraph(tree)
+    model = JaxModel(graph=graph)
+
+    # Map every statement to the function whose body owns it, so tracer
+    # calls found anywhere resolve names from the right scope.
+    owner_of: Dict[int, str] = {}
+    for qual, info in graph.functions.items():
+        from tools.pstpu_lint.callgraph import _own_statements
+
+        for node in _own_statements(info.node):
+            owner_of[id(node)] = qual
+
+    for node in ast.walk(tree):
+        # ---- decorated defs: @jax.jit / @partial(jax.jit, ...) ----------
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                donate: Tuple[int, ...] = ()
+                dnames: Tuple[str, ...] = ()
+                static: Tuple[str, ...] = ()
+                is_jit = False
+                if _callable_name(deco) in _TRACERS:
+                    is_jit = True
+                elif isinstance(deco, ast.Call):
+                    if _callable_name(deco.func) in _TRACERS:
+                        is_jit = True
+                        donate, dnames, static = _jit_signature(deco)
+                    elif (_callable_name(deco.func) == "partial"
+                          and deco.args
+                          and _callable_name(deco.args[0]) in _TRACERS):
+                        is_jit = True
+                        donate, dnames, static = _jit_signature(deco)
+                if is_jit:
+                    qual = _qual_of_def(graph, node)
+                    if qual is not None:
+                        model.seeds.setdefault(qual, static)
+                        model.bindings[node.name] = JitBinding(
+                            node.name, qual,
+                            _donate_positions(graph, qual, donate, dnames),
+                            static, node.lineno)
+            continue
+
+        if not isinstance(node, ast.Call):
+            continue
+        kind = _is_tracer_call(node)
+        if not kind or not node.args:
+            continue
+        owner = owner_of.get(id(node))
+        # Tracer calls nested in expressions still need an owner; walk up
+        # is not available, so fall back to scanning all functions whose
+        # span contains the call line (rare path; assignments cover most).
+        if owner is None:
+            owner = _owner_by_span(graph, node.lineno)
+        target_fn = _resolve_callable(graph, owner, node.args[0]) \
+            if owner else None
+        if target_fn is None and isinstance(node.args[0], ast.Name):
+            target_fn = node.args[0].id \
+                if node.args[0].id in graph.functions else None
+        if kind == "jit":
+            _donate, _dnames, static = _jit_signature(node)
+            if target_fn is not None:
+                model.seeds.setdefault(target_fn, static)
+        elif target_fn is not None:
+            # Every parameter of a scan/cond/shard_map body is traced.
+            model.seeds.setdefault(target_fn, ())
+
+    # ---- bindings from assignments ------------------------------------
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        key = _binding_key(node.targets[0])
+        if key is None or not isinstance(node.value, ast.Call):
+            continue
+        call = node.value
+        if _is_tracer_call(call) == "jit" and call.args:
+            donate, dnames, static = _jit_signature(call)
+            owner = owner_of.get(id(node)) or _owner_by_span(
+                graph, node.lineno)
+            impl = _resolve_callable(graph, owner, call.args[0]) \
+                if owner else None
+            model.bindings[key] = JitBinding(
+                key, impl,
+                _donate_positions(graph, impl, donate, dnames),
+                static, node.lineno)
+            continue
+        # One level of factory indirection: self._x = self._make_x(...)
+        owner = owner_of.get(id(node)) or _owner_by_span(graph, node.lineno)
+        maker = _resolve_callable(graph, owner, call.func) if owner else None
+        if maker is not None and _returns_jit(graph, maker):
+            donate, static, impl = _factory_signature(graph, maker)
+            model.bindings[key] = JitBinding(
+                key, impl or None, donate, static, node.lineno)
+
+    return model
+
+
+def _qual_of_def(graph: CallGraph, node: ast.AST) -> Optional[str]:
+    for qual, info in graph.functions.items():
+        if info.node is node:
+            return qual
+    return None
+
+
+def _owner_by_span(graph: CallGraph, lineno: int) -> Optional[str]:
+    best: Optional[str] = None
+    best_span = None
+    for qual, info in graph.functions.items():
+        n = info.node
+        end = getattr(n, "end_lineno", None) or n.lineno
+        if n.lineno <= lineno <= end:
+            span = end - n.lineno
+            if best_span is None or span < best_span:
+                best, best_span = qual, span
+    return best
+
+
+def _returns_jit(graph: CallGraph, qual: str) -> bool:
+    info = graph.functions.get(qual)
+    if info is None:
+        return False
+    from tools.pstpu_lint.callgraph import _own_statements
+
+    for node in _own_statements(info.node):
+        if (isinstance(node, ast.Return) and isinstance(node.value, ast.Call)
+                and _is_tracer_call(node.value) == "jit"):
+            return True
+    return False
+
+
+def _factory_signature(graph: CallGraph, qual: str):
+    info = graph.functions[qual]
+    from tools.pstpu_lint.callgraph import _own_statements
+
+    for node in _own_statements(info.node):
+        if (isinstance(node, ast.Return) and isinstance(node.value, ast.Call)
+                and _is_tracer_call(node.value) == "jit"):
+            donate, dnames, static = _jit_signature(node.value)
+            impl = None
+            if node.value.args:
+                impl = _resolve_callable(graph, qual, node.value.args[0])
+            return _donate_positions(graph, impl, donate, dnames), \
+                static, impl or ""
+    return (), (), ""

@@ -15,7 +15,11 @@ from tools.pstpu_lint.rules import (
     fire_and_forget,
     flag_drift,
     metrics_drift,
+    shared_state_race,
     swallowed_exceptions,
+    trace_hazards,
+    use_after_donate,
+    wire_drift,
 )
 
 DATA_PLANE_SCOPES = (
@@ -25,15 +29,33 @@ DATA_PLANE_SCOPES = (
     "production_stack_tpu/kv_offload",
 )
 
+# The JAX plane: where jit dispatch, donation, and tracing happen. The
+# donation/trace rules are cheap no-ops on modules with no jit bindings,
+# but scoping keeps their heuristics away from test fixtures and scripts.
+JAX_PLANE_SCOPES = (
+    "production_stack_tpu/engine",
+    "production_stack_tpu/ops",
+    "production_stack_tpu/models",
+    "production_stack_tpu/parallel",
+)
+
 FILE_RULES = [
     ("PL001", DATA_PLANE_SCOPES, blocked_event_loop.check),
     ("PL002", None, fire_and_forget.check),
-    ("PL003", DATA_PLANE_SCOPES + ("production_stack_tpu/tracing.py",),
+    # engine/runner.py rides along for PL003: its donation-race guards
+    # must be typed (RuntimeError/ValueError) or carry a reasoned waiver.
+    ("PL003", DATA_PLANE_SCOPES + ("production_stack_tpu/tracing.py",
+                                   "production_stack_tpu/engine/runner.py"),
      swallowed_exceptions.check),
     ("PL005", None, await_under_lock.check),
+    ("PL007", JAX_PLANE_SCOPES, use_after_donate.check),
+    ("PL008", JAX_PLANE_SCOPES, trace_hazards.check),
+    ("PL009", DATA_PLANE_SCOPES + JAX_PLANE_SCOPES,
+     shared_state_race.check),
 ]
 
 PROJECT_RULES = [
     ("PL004", metrics_drift.wants, metrics_drift.check),
     ("PL006", flag_drift.wants, flag_drift.check),
+    ("PL010", wire_drift.wants, wire_drift.check),
 ]

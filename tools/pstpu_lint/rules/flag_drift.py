@@ -13,6 +13,17 @@ config. Each ``add_argument("--x")`` must have:
   * documentation: the literal ``--x`` appears in README.md (the generated
     flag tables — ``python -m tools.pstpu_lint.gen_docs`` — keep this
     satisfied automatically).
+
+The helm leg extends the same contract one layer up, both directions:
+
+  * every ``tpuConfig.*``/``routerSpec.*`` value a template renders next
+    to a ``--flag`` must name a REAL flag of the matching parser
+    (tpuConfig -> the engine entrypoint, routerSpec -> the router parser)
+    — the next silently-dead helm knob fails here;
+  * every such key must be declared in ``values.schema.json``;
+  * reverse: every tpuConfig/routerSpec property in the schema (and every
+    routerSpec key in ``values.yaml``) must be consumed by some template —
+    a schema'd knob no template reads is dead config with documentation.
 """
 
 import ast
@@ -20,7 +31,12 @@ import os
 from typing import List, Set
 
 from tools.pstpu_lint.core import Finding
-from tools.pstpu_lint.flags import scan_flags
+from tools.pstpu_lint.flags import (
+    scan_flags,
+    scan_helm_schema_keys,
+    scan_helm_values_keys,
+    scan_helm_wirings,
+)
 
 # parser file -> package subtrees whose args.<dest> reads count for it.
 PARSER_FILES = {
@@ -30,6 +46,15 @@ PARSER_FILES = {
         ("production_stack_tpu/server",),
 }
 README = "README.md"
+
+HELM_TEMPLATES = "helm/templates"
+HELM_VALUES = "helm/values.yaml"
+HELM_SCHEMA = "helm/values.schema.json"
+# helm section -> the parser whose flags it must name.
+HELM_SECTION_PARSERS = {
+    "tpuConfig": "production_stack_tpu/server/api_server.py",
+    "routerSpec": "production_stack_tpu/router/parser.py",
+}
 
 
 def _referenced_dests(*scope_roots: str) -> Set[str]:
@@ -100,6 +125,83 @@ def check_flags(
     return findings
 
 
+def check_helm(
+    project_root: str,
+    templates_dir=HELM_TEMPLATES,
+    values_file=HELM_VALUES,
+    schema_file=HELM_SCHEMA,
+    section_parsers=None,
+) -> List[Finding]:
+    """The helm-drift leg (skips cleanly when the chart is absent)."""
+    section_parsers = HELM_SECTION_PARSERS if section_parsers is None \
+        else section_parsers
+    tdir = os.path.join(project_root, templates_dir)
+    schema_path = os.path.join(project_root, schema_file)
+    values_path = os.path.join(project_root, values_file)
+    if not (os.path.isdir(tdir) and os.path.exists(schema_path)):
+        return []
+    findings: List[Finding] = []
+
+    parser_flags = {}
+    for section, rel in section_parsers.items():
+        path = os.path.join(project_root, rel)
+        if os.path.exists(path):
+            with open(path, encoding="utf-8") as f:
+                parser_flags[section] = {
+                    fl.option for fl in scan_flags(f.read())}
+    with open(schema_path, encoding="utf-8") as f:
+        schema_keys = scan_helm_schema_keys(f.read())
+    values_keys = {"routerSpec": set()}
+    if os.path.exists(values_path):
+        with open(values_path, encoding="utf-8") as f:
+            values_keys = scan_helm_values_keys(f.read())
+
+    referenced = {"tpuConfig": set(), "routerSpec": set()}
+    for name in sorted(os.listdir(tdir)):
+        if not name.endswith((".yaml", ".yml", ".tpl")):
+            continue
+        rel = f"{templates_dir}/{name}"
+        with open(os.path.join(tdir, name), encoding="utf-8") as f:
+            wirings = scan_helm_wirings(f.read())
+        for w in wirings:
+            if w.section not in referenced:
+                continue
+            referenced[w.section].add(w.key)
+            flags = parser_flags.get(w.section)
+            if w.flag is not None and flags is not None \
+                    and w.flag not in flags:
+                findings.append(Finding(
+                    "PL006", rel, w.line,
+                    f"helm key {w.dotted} renders flag {w.flag} which does "
+                    f"not exist in {section_parsers[w.section]} — dead "
+                    f"helm knob (operators set it, nothing changes)",
+                ))
+            if w.key not in schema_keys.get(w.section, set()):
+                findings.append(Finding(
+                    "PL006", rel, w.line,
+                    f"helm key {w.dotted} is not declared in "
+                    f"{schema_file} — schema validation silently passes "
+                    f"typos of it",
+                ))
+    # Reverse direction: schema'd / defaulted keys no template consumes.
+    for section, keys in schema_keys.items():
+        for key in sorted(keys - referenced.get(section, set())):
+            findings.append(Finding(
+                "PL006", schema_file, 1,
+                f"helm key {section}.{key} is declared in the schema but "
+                f"no template under {templates_dir} consumes it — dead "
+                f"config with documentation",
+            ))
+    for section, keys in values_keys.items():
+        for key in sorted(keys - schema_keys.get(section, set())):
+            findings.append(Finding(
+                "PL006", values_file, 1,
+                f"helm key {section}.{key} has a default in {values_file} "
+                f"but is missing from {schema_file}",
+            ))
+    return findings
+
+
 # ------------------------------------------------------------- registration
 def wants(project_root: str) -> bool:
     return all(
@@ -121,4 +223,5 @@ def check(project_root: str) -> List[Finding]:
             f"README flag table {tier!r} is {what}; run "
             f"python -m tools.pstpu_lint.gen_docs",
         ))
+    findings += check_helm(project_root)
     return findings

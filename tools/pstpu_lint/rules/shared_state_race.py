@@ -1,0 +1,387 @@
+"""PL009 async shared-state races: RMW across await, cross-context writes.
+
+The router process mixes three execution contexts over one object graph:
+the event loop (handlers), daemon threads (the stats scraper, service
+discovery watch, spiller), and executor workers. Two race shapes this rule
+catches, extending PL005's lock-name model:
+
+  * **read-modify-write spanning an await** — in an ``async def``, a
+    ``self.X`` value is read, the coroutine parks at an ``await``, and the
+    stale value is written back afterwards::
+
+        n = self.inflight          # read
+        await self._relay(chunk)   # another task interleaves here
+        self.inflight = n + 1      # lost update
+
+    Flagged unless the whole span sits under ``async with <lock>``. Taint
+    is one level deep: the written value must read ``self.X`` itself or a
+    local assigned from an expression reading ``self.X`` before the await.
+
+  * **cross-context unlocked mutation** — within a class that spawns
+    threads (``threading.Thread(target=self._worker)`` /
+    ``run_in_executor``/``asyncio.to_thread``) or mixes async methods with
+    thread workers: an attribute mutated under a ``with <lock>`` somewhere
+    (the class's locking discipline) but mutated elsewhere with **no**
+    lock held is flagged at the unlocked site. Lock context propagates
+    through the module-local call graph: a helper only ever called from
+    inside ``with lock:`` blocks counts as locked (the
+    ``RemoteKVClient._ensure_sock`` shape). ``__init__``/``__new__``
+    writes are construction (happens-before publication) and exempt.
+
+The fix is a lock, an ``asyncio.Lock``, or the atomic-swap idiom the
+scraper uses (build ``fresh``, assign once under the lock).
+"""
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.pstpu_lint.callgraph import CallGraph, _own_statements
+from tools.pstpu_lint.core import Finding
+
+_LOCKISH = ("lock", "mutex")
+
+
+def _walk_pruned(node: ast.AST):
+    """ast.walk that does not descend into nested function/class/lambda
+    bodies — they are separate execution contexts (a deferred lambda read
+    evaluates at CALL time, not where it is written)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            stack.append(child)
+_MUTATORS = {"append", "add", "discard", "update", "pop", "clear",
+             "extend", "remove", "setdefault", "popitem", "insert"}
+_CTOR_NAMES = {"__init__", "__new__", "__post_init__"}
+
+
+def _lock_name(expr: ast.AST) -> str:
+    name = ""
+    if isinstance(expr, ast.Name):
+        name = expr.id
+    elif isinstance(expr, ast.Attribute):
+        name = expr.attr
+    elif isinstance(expr, ast.Call):
+        return _lock_name(expr.func)
+    low = name.lower()
+    return name if any(tok in low for tok in _LOCKISH) else ""
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _mutations(node: ast.AST):
+    """(attr, line) when this ONE node mutates a self.X attribute:
+    assignment / aug-assignment / subscript store / mutator method call.
+    Non-recursive — callers feed it every node of a pruned walk, so each
+    mutation site is seen exactly once."""
+    if isinstance(node, ast.Assign):
+        targets = []
+        for t in node.targets:
+            targets.extend(
+                t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t])
+        for t in targets:
+            attr = _self_attr(t)
+            if attr is not None:
+                yield attr, node.lineno
+            elif isinstance(t, ast.Subscript):
+                attr = _self_attr(t.value)
+                if attr is not None:
+                    yield attr, node.lineno
+    elif isinstance(node, ast.AugAssign):
+        attr = _self_attr(node.target)
+        if attr is None and isinstance(node.target, ast.Subscript):
+            attr = _self_attr(node.target.value)
+        if attr is not None:
+            yield attr, node.lineno
+    elif (isinstance(node, ast.Call)
+          and isinstance(node.func, ast.Attribute)
+          and node.func.attr in _MUTATORS):
+        attr = _self_attr(node.func.value)
+        if attr is not None:
+            yield attr, node.lineno
+
+
+# --------------------------------------------------------------------- RMW
+class _RmwScan:
+    """One async function body: self.X reads -> await -> self.X write."""
+
+    def __init__(self, relpath: str):
+        self.relpath = relpath
+        self.findings: List[Finding] = []
+        # attr -> line of the earliest pre-await read still "live"
+        self.reads: Dict[str, int] = {}
+        # local name -> self attrs its value was derived from
+        self.derived: Dict[str, Set[str]] = {}
+        self.awaited_since: Dict[str, int] = {}   # attr -> await line
+
+    def _expr_attr_reads(self, expr: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        if isinstance(expr, (ast.Lambda, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            return out   # deferred body: evaluates at call time, not here
+        for node in _walk_pruned(expr):
+            attr = _self_attr(node)
+            if attr is not None and isinstance(node.ctx, ast.Load):
+                out.add(attr)
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                out |= self.derived.get(node.id, set())
+        return out
+
+    def _simple_stmt(self, stmt: ast.stmt, under_async_lock: bool) -> None:
+        has_await = any(
+            isinstance(n, ast.Await) for n in _walk_pruned(stmt))
+        # Writes first: a write whose value depends on a pre-await read
+        # of the same attr is the lost-update shape.
+        if isinstance(stmt, ast.Assign) and not under_async_lock:
+            for t in stmt.targets:
+                attr = _self_attr(t)
+                if attr is None:
+                    continue
+                if attr in self.awaited_since:
+                    deps = self._expr_attr_reads(stmt.value)
+                    if attr in deps:
+                        self.findings.append(Finding(
+                            "PL009", self.relpath, stmt.lineno,
+                            f"self.{attr} is read before the await "
+                            f"(line {self.reads.get(attr, '?')}) and "
+                            f"written back after it (await at line "
+                            f"{self.awaited_since[attr]}) — another "
+                            f"task can interleave and the update is "
+                            f"lost; hold an asyncio.Lock across the "
+                            f"read-modify-write or recompute after "
+                            f"the await",
+                        ))
+        # Record reads + derived locals.
+        if isinstance(stmt, ast.Assign):
+            deps = self._expr_attr_reads(stmt.value)
+            for attr in deps:
+                self.reads.setdefault(attr, stmt.lineno)
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    self.derived[t.id] = set(deps)
+        else:
+            for attr in self._expr_attr_reads(stmt):
+                self.reads.setdefault(attr, stmt.lineno)
+        # A write CLEARS the attr's pre-await read state: the next read
+        # starts a fresh (possibly race-free) generation — without this, a
+        # loop-body `self.x = self.x + n; await f()` would flag iteration
+        # k+1's write against iteration k's await even though read and
+        # write are adjacent.
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    self.reads.pop(attr, None)
+                    self.awaited_since.pop(attr, None)
+        if has_await:
+            for attr in self.reads:
+                self.awaited_since.setdefault(attr, stmt.lineno)
+
+    def scan(self, body: List[ast.stmt], under_async_lock: bool) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            # Compound statements: record only their HEADER expressions at
+            # this level, then recurse — blanket-recording a whole loop
+            # body's reads/awaits up front would order every read before
+            # every await regardless of actual position.
+            if isinstance(stmt, (ast.AsyncWith, ast.With)):
+                locked = under_async_lock or (
+                    isinstance(stmt, ast.AsyncWith) and any(
+                        _lock_name(item.context_expr) for item in stmt.items)
+                )
+                self.scan(stmt.body, locked)
+            elif isinstance(stmt, (ast.If, ast.While)):
+                for attr in self._expr_attr_reads(stmt.test):
+                    self.reads.setdefault(attr, stmt.lineno)
+                self.scan(stmt.body, under_async_lock)
+                self.scan(stmt.orelse, under_async_lock)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                for attr in self._expr_attr_reads(stmt.iter):
+                    self.reads.setdefault(attr, stmt.lineno)
+                self.scan(stmt.body, under_async_lock)
+                self.scan(stmt.orelse, under_async_lock)
+            elif isinstance(stmt, ast.Try):
+                self.scan(stmt.body, under_async_lock)
+                for handler in stmt.handlers:
+                    self.scan(handler.body, under_async_lock)
+                self.scan(stmt.orelse, under_async_lock)
+                self.scan(stmt.finalbody, under_async_lock)
+            else:
+                self._simple_stmt(stmt, under_async_lock)
+
+
+# ------------------------------------------------------- cross-context map
+def _thread_targets(tree: ast.AST, graph: CallGraph) -> Set[str]:
+    """Qualnames of functions handed to Thread(target=...) /
+    run_in_executor / asyncio.to_thread, expanded through self-calls."""
+    seeds: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        cands: List[ast.AST] = []
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else "")
+        if name == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    cands.append(kw.value)
+        elif name == "run_in_executor" and len(node.args) >= 2:
+            cands.append(node.args[1])
+        elif name == "to_thread" and node.args:
+            cands.append(node.args[0])
+        for cand in cands:
+            attr = _self_attr(cand)
+            if attr is not None:
+                for qual, info in graph.functions.items():
+                    if qual.endswith("." + attr) or qual == attr:
+                        seeds.add(qual)
+            elif isinstance(cand, ast.Name) and cand.id in graph.functions:
+                seeds.add(cand.id)
+    # Expand through module-local calls (a worker's helpers run on the
+    # worker thread too).
+    frontier = list(seeds)
+    while frontier:
+        qual = frontier.pop()
+        info = graph.functions.get(qual)
+        if info is None:
+            continue
+        for callee, _line in info.calls:
+            if callee not in seeds:
+                seeds.add(callee)
+                frontier.append(callee)
+    return seeds
+
+
+def _locked_spans(fn_node: ast.AST) -> List[Tuple[int, int, str]]:
+    """(start, end, lockname) line spans of sync ``with <lock>`` blocks."""
+    spans = []
+    for node in ast.walk(fn_node):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                lock = _lock_name(item.context_expr)
+                if lock:
+                    end = getattr(node, "end_lineno", node.lineno)
+                    spans.append((node.lineno, end, lock))
+    return spans
+
+
+def _line_locked(spans, line: int) -> Optional[str]:
+    for start, end, lock in spans:
+        if start <= line <= end:
+            return lock
+    return None
+
+
+def _always_called_locked(qual: str, graph: CallGraph,
+                          lock_spans: Dict[str, list]) -> bool:
+    """True when every module-local call site of ``qual`` sits inside a
+    with-lock span (the helper-under-lock shape)."""
+    sites = []
+    for caller, info in graph.functions.items():
+        for callee, line in info.calls:
+            if callee == qual:
+                sites.append((caller, line))
+    if not sites:
+        return False
+    return all(
+        _line_locked(lock_spans.get(caller, []), line) is not None
+        for caller, line in sites
+    )
+
+
+def _only_called_from_ctor(qual: str, graph: CallGraph) -> bool:
+    """True when every module-local call site of ``qual`` is inside a
+    constructor — the ``self._load()``-from-``__init__`` shape. The object
+    is not published yet (happens-before), so its writes are exempt like
+    the constructor's own."""
+    sites = []
+    for caller, info in graph.functions.items():
+        for callee, _line in info.calls:
+            if callee == qual:
+                sites.append(caller)
+    if not sites:
+        return False
+    return all(s.rsplit(".", 1)[-1] in _CTOR_NAMES for s in sites)
+
+
+def check(relpath: str, tree: ast.AST, source: str) -> List[Finding]:
+    graph = CallGraph(tree)
+    findings: List[Finding] = []
+
+    # ---- RMW across await ---------------------------------------------
+    for qual, info in graph.functions.items():
+        if not info.is_async:
+            continue
+        scan = _RmwScan(relpath)
+        scan.scan(info.node.body, under_async_lock=False)
+        findings.extend(scan.findings)
+
+    # ---- cross-context unlocked mutation ------------------------------
+    threaded = _thread_targets(tree, graph)
+    async_ctx = set(graph.async_context())
+    lock_spans = {
+        qual: _locked_spans(info.node)
+        for qual, info in graph.functions.items()
+    }
+    # Per class: attr -> [(qual, line, lock-or-None)]
+    per_class: Dict[str, Dict[str, list]] = {}
+    spawns_threads: Set[str] = set()
+    for qual, info in graph.functions.items():
+        cls = info.enclosing_class
+        if cls is None:
+            continue
+        if qual in threaded:
+            spawns_threads.add(cls)
+        if qual.rsplit(".", 1)[-1] in _CTOR_NAMES:
+            continue
+        if _only_called_from_ctor(qual, graph):
+            continue
+        spans = lock_spans.get(qual, [])
+        inherited = (
+            "(callers)" if _always_called_locked(qual, graph, lock_spans)
+            else None
+        )
+        for node in _own_statements(info.node):
+            if not isinstance(node, ast.stmt):
+                continue
+            for attr, line in _mutations(node):
+                lock = _line_locked(spans, line) or inherited
+                per_class.setdefault(cls, {}).setdefault(attr, []).append(
+                    (qual, line, lock))
+    for cls, attrs in per_class.items():
+        # Only classes that actually spawn threads have a cross-THREAD
+        # surface; async-only interleaving is the RMW check's job (a
+        # coroutine cannot preempt a sync mutation mid-statement).
+        if cls not in spawns_threads:
+            continue
+        for attr, sites in attrs.items():
+            locked_sites = [s for s in sites if s[2] is not None]
+            unlocked = [s for s in sites if s[2] is None]
+            if not locked_sites or not unlocked:
+                continue
+            # The discipline exists (a locked mutation) and is violated
+            # (an unlocked one elsewhere). Same-function pairs are still
+            # races when the class mixes contexts.
+            lock = locked_sites[0][2]
+            for qual, line, _none in unlocked:
+                findings.append(Finding(
+                    "PL009", relpath, line,
+                    f"self.{attr} is mutated under {lock} elsewhere in "
+                    f"{cls} (e.g. line {locked_sites[0][1]}) but mutated "
+                    f"here without the lock — cross-thread lost update; "
+                    f"take the lock or swap atomically",
+                ))
+    return findings

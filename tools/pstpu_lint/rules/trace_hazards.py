@@ -1,0 +1,224 @@
+"""PL008 trace hazards: host sync, tracer branching, recompile churn.
+
+Three hazard families inside *traced* code — function bodies reachable
+from a ``jax.jit``/``pjit``/``lax.scan``/``while_loop``/``cond``/
+``shard_map`` region per the module-local call graph
+(tools/pstpu_lint/jaxmodel.py):
+
+  * **host-sync calls** — ``.item()``, ``.block_until_ready()``,
+    ``jax.device_get(...)``, and ``np.asarray``/``np.array``/``float()``/
+    ``int()`` applied to a traced parameter. Inside a trace these either
+    abort compilation (ConcretizationTypeError at the worst possible time
+    — first request of a new shape family) or silently force a device
+    sync per step;
+  * **Python branching on tracer-typed parameters** — ``if``/``while``
+    over a bare (non-static) parameter of the traced function. Static
+    arguments declared via ``static_argnames`` are exempt, as is shape/
+    dtype metadata (``x.shape[0] > 1`` is static and idiomatic);
+  * **per-call-varying static arguments at dispatch sites** — passing
+    ``time.*()``/``random.*()``/``datetime.*()`` into a jitted callable's
+    ``static_argnames`` keyword recompiles on every call. The engine's
+    convention is bucketing (``b=b, mb=mb`` through ``_bucket``), which
+    this check leaves alone.
+
+Like the rest of the suite the analysis is module-local: the engine's
+traced impls, their helpers, and their dispatch sites all live in
+engine/runner.py and ops/.
+"""
+
+import ast
+from typing import List, Optional, Set
+
+from tools.pstpu_lint import jaxmodel
+from tools.pstpu_lint.callgraph import _own_statements
+from tools.pstpu_lint.core import Finding
+
+_HOST_SYNC_METHODS = {"item", "block_until_ready", "tolist"}
+_NP_SYNC_FNS = {"asarray", "array"}
+_VARYING_ROOTS = {"time", "random", "datetime", "uuid"}
+
+
+def _param_names(node: ast.AST) -> Set[str]:
+    args = node.args
+    names = {a.arg for a in args.args + args.posonlyargs + args.kwonlyargs}
+    names.discard("self")
+    names.discard("cls")
+    return names
+
+
+def _references_param(expr: ast.AST, params: Set[str]) -> bool:
+    """True when ``expr`` reads a traced parameter *directly* (a bare Name
+    — not ``x.shape``/``x.dtype`` metadata, which is static)."""
+    meta_reads = set()
+    for node in ast.walk(expr):
+        if (isinstance(node, ast.Attribute)
+                and node.attr in ("shape", "dtype", "ndim", "size",
+                                  "itemsize")
+                and isinstance(node.value, ast.Name)):
+            meta_reads.add(id(node.value))
+    for node in ast.walk(expr):
+        if (isinstance(node, ast.Name) and node.id in params
+                and isinstance(node.ctx, ast.Load)
+                and id(node) not in meta_reads):
+            return True
+    return False
+
+
+def _bare_tracer_test(test: ast.AST, params: Set[str]) -> Optional[str]:
+    """The offending parameter name when ``test`` is Python control flow
+    over a bare tracer param: the param itself, a Compare/BoolOp/UnaryOp
+    over bare params and constants. Attribute access (shape/dtype) makes
+    the test static — not flagged."""
+    if isinstance(test, ast.Name):
+        return test.id if test.id in params else None
+    if isinstance(test, ast.UnaryOp):
+        return _bare_tracer_test(test.operand, params)
+    if isinstance(test, ast.Compare):
+        # Identity tests are static config dispatch, not tracer reads:
+        # ``if ring_mesh is not None`` branches on whether an OPTIONAL
+        # argument was provided, which is fixed at trace time.
+        if any(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+            return None
+        sides = [test.left] + list(test.comparators)
+        hit = None
+        for side in sides:
+            if isinstance(side, ast.Name) and side.id in params:
+                hit = side.id
+            elif not isinstance(side, ast.Constant):
+                return None   # derived expression — too static-likely
+        return hit
+    if isinstance(test, ast.BoolOp):
+        for v in test.values:
+            hit = _bare_tracer_test(v, params)
+            if hit:
+                return hit
+    return None
+
+
+def _is_varying_call(expr: ast.AST) -> bool:
+    """time.time(), random.random(), datetime.now() shapes."""
+    if not isinstance(expr, ast.Call):
+        return False
+    fn = expr.func
+    root = None
+    if isinstance(fn, ast.Attribute):
+        node = fn
+        while isinstance(node, ast.Attribute):
+            node = node.value
+        if isinstance(node, ast.Name):
+            root = node.id
+    elif isinstance(fn, ast.Name):
+        root = fn.id
+    return root in _VARYING_ROOTS
+
+
+def check(relpath: str, tree: ast.AST, source: str) -> List[Finding]:
+    model = jaxmodel.build(tree)
+    findings: List[Finding] = []
+    chains = model.traced_context()
+
+    for qual, chain in chains.items():
+        info = model.graph.functions.get(qual)
+        if info is None:
+            continue
+        seed = chain[0]
+        # The seed's static_argnames exempt the same NAMES down the call
+        # chain too — the engine threads statics through by name
+        # (``use_cached_window`` stays ``use_cached_window`` in helpers).
+        static = set(model.seeds.get(seed, ()))
+        params = _param_names(info.node)
+        traced_params = params - static
+        via = f" (traced via {' -> '.join(chain)})" if len(chain) > 1 \
+            else f" (inside traced region {qual})"
+
+        for node in _own_statements(info.node):
+            # ---- host-sync calls --------------------------------------
+            if isinstance(node, ast.Call):
+                fn = node.func
+                if (isinstance(fn, ast.Attribute)
+                        and fn.attr in _HOST_SYNC_METHODS):
+                    findings.append(Finding(
+                        "PL008", relpath, node.lineno,
+                        f".{fn.attr}() forces a host sync inside traced "
+                        f"code{via}; keep the value on device or hoist the "
+                        f"read out of the jit/scan region",
+                    ))
+                    continue
+                if (isinstance(fn, ast.Attribute)
+                        and fn.attr == "device_get"
+                        and isinstance(fn.value, ast.Name)
+                        and fn.value.id == "jax"):
+                    findings.append(Finding(
+                        "PL008", relpath, node.lineno,
+                        f"jax.device_get() inside traced code{via} breaks "
+                        f"the trace; return the value instead",
+                    ))
+                    continue
+                if (isinstance(fn, ast.Attribute)
+                        and fn.attr in _NP_SYNC_FNS
+                        and isinstance(fn.value, ast.Name)
+                        and fn.value.id in ("np", "numpy")
+                        and node.args
+                        and _references_param(node.args[0], traced_params)):
+                    findings.append(Finding(
+                        "PL008", relpath, node.lineno,
+                        f"np.{fn.attr}() on a traced value{via} "
+                        f"concretizes the tracer (host round-trip); use "
+                        f"jnp inside the region",
+                    ))
+                    continue
+                if (isinstance(fn, ast.Name) and fn.id in ("float", "int")
+                        and node.args
+                        and isinstance(node.args[0], ast.Name)
+                        and node.args[0].id in traced_params):
+                    findings.append(Finding(
+                        "PL008", relpath, node.lineno,
+                        f"{fn.id}() on traced parameter "
+                        f"{node.args[0].id!r}{via} concretizes the tracer; "
+                        f"keep it a jnp scalar or mark the argument "
+                        f"static",
+                    ))
+                    continue
+            # ---- Python branching on tracer params --------------------
+            if isinstance(node, (ast.If, ast.While)):
+                hit = _bare_tracer_test(node.test, traced_params)
+                if hit:
+                    findings.append(Finding(
+                        "PL008", relpath, node.lineno,
+                        f"Python {type(node).__name__.lower()} on traced "
+                        f"parameter {hit!r}{via} concretizes the tracer at "
+                        f"trace time; use lax.cond/jnp.where, or declare "
+                        f"it in static_argnames",
+                    ))
+
+    # ---- per-call-varying static args at dispatch sites ---------------
+    varying_static = {
+        key: b for key, b in model.bindings.items() if b.static_names
+    }
+    if varying_static:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            key = None
+            if isinstance(node.func, ast.Name):
+                key = node.func.id
+            elif (isinstance(node.func, ast.Attribute)
+                  and isinstance(node.func.value, ast.Name)
+                  and node.func.value.id in ("self", "cls")):
+                key = f"self.{node.func.attr}"
+            binding = varying_static.get(key) if key else None
+            if binding is None and key and key.startswith("self."):
+                binding = varying_static.get(key[len("self."):])
+            if binding is None:
+                continue
+            for kw in node.keywords:
+                if kw.arg in binding.static_names \
+                        and _is_varying_call(kw.value):
+                    findings.append(Finding(
+                        "PL008", relpath, node.lineno,
+                        f"static argument {kw.arg!r} of {binding.key} is "
+                        f"per-call-varying here — every call compiles a "
+                        f"fresh executable; bucket the value (engine "
+                        f"_bucket idiom) or make it traced",
+                    ))
+    return findings

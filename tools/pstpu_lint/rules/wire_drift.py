@@ -1,0 +1,334 @@
+"""PL010 wire-protocol drift: formats, ops, registry, and docs must agree.
+
+Four versioned wire formats (``PKV1``/``PKV2``/``PKC1``/``PDX1``) and the
+KV-server op set (``P G E D M I H T``) are spoken by three peers — the
+engine-side client, the Python server, the native C++ server — plus every
+blob already sitting in a store. A new version with an encoder but no
+decoder, an op the client issues but no server dispatches, or a docs table
+describing last month's protocol is exactly the drift that corrupts stores
+silently. Checks, all against ``tools/pstpu_lint/wire_registry.py``:
+
+  1. every magic-shaped bytes literal (``P??<digit>``) observed in
+     ``kv_offload/``+``disagg/`` is registered — an unregistered magic is
+     a new wire version nobody decided the lineage of;
+  2. every observed magic has BOTH an encoder occurrence (used in
+     ``struct.pack``/bytes construction) and a decoder occurrence (used in
+     an ``==``/``!=``/``in`` comparison) — both directions, per the
+     version-tag contract; retired formats must have no encoder;
+  3. every registered, non-retired format is actually implemented
+     (observed at all);
+  4. ops: every op the client issues (``_request(b"X"``) is dispatched by
+     the Python server (``op == b"X"``) and vice versa, all registered,
+     and the registry's per-op native coverage matches
+     ``native/kv_server.cpp``'s ``case 'X':`` set;
+  5. the registered key namespaces (``q8|``) appear in the key-building
+     code;
+  6. the generated ``docs/WIRE_FORMATS.md`` tables are fresh
+     (PL004-style freshness gate — run ``python -m
+     tools.pstpu_lint.gen_docs``).
+"""
+
+import ast
+import os
+import re
+from typing import Dict, List, Set, Tuple
+
+from tools.pstpu_lint import wire_registry as reg
+from tools.pstpu_lint.core import Finding
+
+SCAN_DIRS = ("production_stack_tpu/kv_offload", "production_stack_tpu/disagg")
+PY_SERVER = "production_stack_tpu/kv_offload/server.py"
+PY_CLIENT = "production_stack_tpu/kv_offload/remote.py"
+NATIVE_SERVER = "native/kv_server.cpp"
+REGISTRY_FILE = "tools/pstpu_lint/wire_registry.py"
+
+_MAGIC_RE = re.compile(r"^P[A-Z]{2}\d$")
+
+
+def _iter_py(project_root: str):
+    for rel_dir in SCAN_DIRS:
+        root = os.path.join(project_root, rel_dir)
+        if not os.path.isdir(root):
+            continue
+        for dirpath, dirs, files in os.walk(root):
+            dirs[:] = [d for d in dirs if d != "__pycache__"]
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    path = os.path.join(dirpath, name)
+                    rel = os.path.relpath(path, project_root).replace(
+                        os.sep, "/")
+                    yield rel, path
+
+
+class _MagicUses(ast.NodeVisitor):
+    """Classify every use of a magic literal (or a name bound to one) as
+    encode-side (value construction) or decode-side (comparison)."""
+
+    def __init__(self):
+        self.aliases: Dict[str, str] = {}     # module var -> magic
+        self.encode: Dict[str, List[int]] = {}
+        self.decode: Dict[str, List[int]] = {}
+        self.first_seen: Dict[str, int] = {}
+        self._compare_depth = 0
+
+    def _magic_of(self, node: ast.AST):
+        if isinstance(node, ast.Constant) and isinstance(node.value, bytes):
+            try:
+                text = node.value.decode("ascii")
+            except UnicodeDecodeError:
+                return None
+            return text if _MAGIC_RE.match(text) else None
+        if isinstance(node, ast.Name):
+            return self.aliases.get(node.id)
+        return None
+
+    def visit_Assign(self, node: ast.Assign):
+        magic = self._magic_of(node.value)
+        if magic is not None:
+            self.first_seen.setdefault(magic, node.lineno)
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.aliases[t.id] = magic
+            return   # the defining assignment is neither side
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare):
+        for side in [node.left] + list(node.comparators):
+            # Membership tests spell the magics inside a tuple/list/set:
+            # ``blob[:4] in (b"PKV1", b"PKV2")`` is a decoder too.
+            elems = (
+                side.elts if isinstance(side, (ast.Tuple, ast.List, ast.Set))
+                else [side]
+            )
+            for elem in elems:
+                magic = self._magic_of(elem)
+                if magic is not None:
+                    self.first_seen.setdefault(magic, elem.lineno)
+                    self.decode.setdefault(magic, []).append(elem.lineno)
+        self._compare_depth += 1
+        self.generic_visit(node)
+        self._compare_depth -= 1
+
+    def generic_visit(self, node: ast.AST):
+        magic = self._magic_of(node)
+        if magic is not None and self._compare_depth == 0:
+            self.first_seen.setdefault(magic, node.lineno)
+            self.encode.setdefault(magic, []).append(node.lineno)
+            return
+        super().generic_visit(node)
+
+
+def _scan_ops_client(source: str) -> Dict[str, int]:
+    """op byte -> line for every _request(b"X", ...) issue site."""
+    out: Dict[str, int] = {}
+    tree = ast.parse(source)
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "_request" and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, bytes)
+                and len(node.args[0].value) == 1):
+            op = node.args[0].value.decode("ascii", "replace")
+            out.setdefault(op, node.lineno)
+    return out
+
+
+def _scan_ops_server(source: str) -> Dict[str, int]:
+    """op byte -> line for every ``op == b"X"`` dispatch comparison."""
+    out: Dict[str, int] = {}
+    tree = ast.parse(source)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        sides = [node.left] + list(node.comparators)
+        names = [s for s in sides if isinstance(s, ast.Name)]
+        lits = [s for s in sides
+                if isinstance(s, ast.Constant)
+                and isinstance(s.value, bytes) and len(s.value) == 1]
+        if lits and any(n.id == "op" for n in names):
+            op = lits[0].value.decode("ascii", "replace")
+            out.setdefault(op, lits[0].lineno)
+    return out
+
+
+def _scan_ops_native(path: str) -> Set[str]:
+    with open(path, encoding="utf-8", errors="replace") as f:
+        text = f.read()
+    return set(re.findall(r"case\s+'([A-Z])'\s*:", text))
+
+
+def check_wire(project_root: str, registry_formats=None, registry_ops=None,
+               docs_check: bool = True) -> List[Finding]:
+    formats = reg.FORMATS if registry_formats is None else registry_formats
+    ops = reg.OPS if registry_ops is None else registry_ops
+    by_magic = {f.magic: f for f in formats}
+    findings: List[Finding] = []
+
+    # ---- formats -------------------------------------------------------
+    all_encode: Dict[str, Tuple[str, int]] = {}
+    all_decode: Dict[str, Tuple[str, int]] = {}
+    observed: Dict[str, Tuple[str, int]] = {}
+    sources: Dict[str, str] = {}
+    for rel, path in _iter_py(project_root):
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        sources[rel] = source
+        try:
+            tree = ast.parse(source)
+        except SyntaxError:
+            continue   # PL000 owns unparseable files
+        uses = _MagicUses()
+        uses.visit(tree)
+        for magic, line in uses.first_seen.items():
+            observed.setdefault(magic, (rel, line))
+        for magic, lines in uses.encode.items():
+            all_encode.setdefault(magic, (rel, lines[0]))
+        for magic, lines in uses.decode.items():
+            all_decode.setdefault(magic, (rel, lines[0]))
+
+    for magic, (rel, line) in sorted(observed.items()):
+        entry = by_magic.get(magic)
+        if entry is None:
+            findings.append(Finding(
+                "PL010", rel, line,
+                f"wire magic {magic!r} is not in the wire registry — a new "
+                f"wire version needs a lineage decision; add it to "
+                f"{REGISTRY_FILE} and regenerate docs/WIRE_FORMATS.md "
+                f"(python -m tools.pstpu_lint.gen_docs)",
+            ))
+            # Still require both directions: a registry entry alone does
+            # not make a half-implemented codec safe.
+        enc = all_encode.get(magic)
+        dec = all_decode.get(magic)
+        if entry is not None and entry.retired:
+            if enc is not None:
+                findings.append(Finding(
+                    "PL010", enc[0], enc[1],
+                    f"wire magic {magic!r} is retired in the registry but "
+                    f"still has an encoder here — stop producing it",
+                ))
+            continue
+        if enc is not None and dec is None:
+            findings.append(Finding(
+                "PL010", enc[0], enc[1],
+                f"wire magic {magic!r} has an encoder here but no decoder "
+                f"anywhere in {' or '.join(SCAN_DIRS)} — blobs written in "
+                f"this version can never be read back",
+            ))
+        if dec is not None and enc is None:
+            findings.append(Finding(
+                "PL010", dec[0], dec[1],
+                f"wire magic {magic!r} has a decoder here but no encoder "
+                f"anywhere in {' or '.join(SCAN_DIRS)} — either the "
+                f"version is retired (mark it in {REGISTRY_FILE}) or the "
+                f"write path was lost",
+            ))
+    for entry in formats:
+        if not entry.retired and entry.magic not in observed:
+            findings.append(Finding(
+                "PL010", REGISTRY_FILE, 1,
+                f"wire magic {entry.magic!r} is registered (non-retired) "
+                f"but never appears in {' or '.join(SCAN_DIRS)} — retire "
+                f"it or implement it",
+            ))
+
+    # ---- key namespaces ------------------------------------------------
+    for ns in reg.KEY_NAMESPACES:
+        token = ns.encode()
+        if not any(repr(token)[1:] in src or ns in src
+                   for src in sources.values()):
+            findings.append(Finding(
+                "PL010", REGISTRY_FILE, 1,
+                f"registered key namespace {ns!r} never appears in the "
+                f"key-building code under {' or '.join(SCAN_DIRS)}",
+            ))
+
+    # ---- ops -----------------------------------------------------------
+    by_op = {o.op: o for o in ops}
+    client_path = os.path.join(project_root, PY_CLIENT)
+    server_path = os.path.join(project_root, PY_SERVER)
+    client_ops: Dict[str, int] = {}
+    server_ops: Dict[str, int] = {}
+    if os.path.exists(client_path):
+        with open(client_path, encoding="utf-8") as f:
+            client_ops = _scan_ops_client(f.read())
+    if os.path.exists(server_path):
+        with open(server_path, encoding="utf-8") as f:
+            server_ops = _scan_ops_server(f.read())
+    for op, line in sorted(client_ops.items()):
+        if op not in by_op:
+            findings.append(Finding(
+                "PL010", PY_CLIENT, line,
+                f"client issues op {op!r} which is not in the wire "
+                f"registry — register it (with its native-server story) "
+                f"in {REGISTRY_FILE}",
+            ))
+        elif op not in server_ops:
+            findings.append(Finding(
+                "PL010", PY_CLIENT, line,
+                f"client issues op {op!r} but the Python server never "
+                f"dispatches it — every peer must speak every registered "
+                f"op",
+            ))
+    for op, line in sorted(server_ops.items()):
+        if op not in by_op:
+            findings.append(Finding(
+                "PL010", PY_SERVER, line,
+                f"server dispatches op {op!r} which is not in the wire "
+                f"registry — register it in {REGISTRY_FILE}",
+            ))
+        elif op not in client_ops:
+            findings.append(Finding(
+                "PL010", PY_SERVER, line,
+                f"server dispatches op {op!r} but the client never issues "
+                f"it — dead protocol surface (or the client-side wiring "
+                f"was lost)",
+            ))
+    for op, entry in by_op.items():
+        if client_ops and op not in client_ops and op not in server_ops:
+            findings.append(Finding(
+                "PL010", REGISTRY_FILE, 1,
+                f"op {op!r} is registered but neither the client nor the "
+                f"Python server implements it",
+            ))
+    native_path = os.path.join(project_root, NATIVE_SERVER)
+    if os.path.exists(native_path) and by_op:
+        native_ops = _scan_ops_native(native_path)
+        want_native = {o.op for o in ops if o.native}
+        for op in sorted(want_native - native_ops):
+            findings.append(Finding(
+                "PL010", NATIVE_SERVER, 1,
+                f"registry marks op {op!r} native-supported but "
+                f"{NATIVE_SERVER} has no case for it",
+            ))
+        for op in sorted((native_ops & set(by_op)) - want_native):
+            findings.append(Finding(
+                "PL010", NATIVE_SERVER, 1,
+                f"{NATIVE_SERVER} implements op {op!r} but the registry "
+                f"marks it non-native — update the registry's coverage "
+                f"column (and docs/WIRE_FORMATS.md)",
+            ))
+
+    # ---- docs freshness ------------------------------------------------
+    if docs_check:
+        from tools.pstpu_lint import gen_docs
+
+        for group, relpath, stale in gen_docs.check_wire_tables(
+            project_root, formats=formats, ops=ops
+        ):
+            findings.append(Finding(
+                "PL010", relpath, 1,
+                f"wire docs table {group!r} is {stale}; run "
+                f"python -m tools.pstpu_lint.gen_docs",
+            ))
+    return findings
+
+
+# ------------------------------------------------------------- registration
+def wants(project_root: str) -> bool:
+    return os.path.isdir(os.path.join(project_root, SCAN_DIRS[0]))
+
+
+def check(project_root: str) -> List[Finding]:
+    return check_wire(project_root)

@@ -1,0 +1,114 @@
+"""Canonical registry of every wire format and KV-server op the stack speaks.
+
+Single source of truth for the PL010 wire-protocol-drift rule and for the
+generated ``docs/WIRE_FORMATS.md`` tables (``python -m
+tools.pstpu_lint.gen_docs``), the same pattern PL004 uses for metrics: the
+code, this registry, and the docs can disagree only by failing the lint.
+
+Two planes:
+
+  * **framed formats** — 4-byte magic-tagged envelopes
+    (``kv_offload/serde.py``, ``disagg/transfer.py``). The magic IS the
+    version tag: a store holding blobs from several generations keeps
+    decoding, so every non-retired version needs an encoder AND a decoder
+    in-tree, both directions. Quantized payloads additionally namespace
+    their STORE KEYS with ``q8|`` so mixed-dtype engines sharing a tier
+    never splice incompatible blocks — the namespace literals are
+    registered here too and must appear in the code that builds keys.
+  * **KV-server ops** — single-byte opcodes of the TCP cache-server
+    protocol (``kv_offload/remote.py`` client, ``kv_offload/server.py``
+    Python server, ``native/kv_server.cpp``). The native C++ server
+    implements a subset and answers ``STATUS_ERROR`` for the rest (the
+    client degrades); which ops it covers is recorded per-op so adding an
+    op without deciding its native story fails the lint.
+
+To add a format/op: implement both directions, add the entry here, then
+run ``python -m tools.pstpu_lint.gen_docs`` to refresh the docs tables.
+"""
+
+from dataclasses import dataclass
+from typing import Tuple
+
+# Store-key namespaces that partition a shared tier by payload dtype.
+# Every namespace must appear in the key-building code (PL010 checks).
+KEY_NAMESPACES: Tuple[str, ...] = ("q8|",)
+
+
+@dataclass(frozen=True)
+class WireFormat:
+    magic: str           # the 4-byte tag, e.g. "PKV2"
+    family: str          # kv-block | chain-envelope | handoff-manifest
+    version: int         # lineage within the family
+    supersedes: str      # previous magic in the lineage ("" for v1)
+    retired: bool        # True = decoders may drop it; no encoder allowed
+    doc: str             # one-line meaning for the docs table
+
+
+@dataclass(frozen=True)
+class WireOp:
+    op: str              # single byte, e.g. "M"
+    name: str
+    batched: bool        # carries a packed key list / multi-part response
+    mutates: bool        # changes store state (read-only ops must not
+                         # refresh LRU recency — the 'I'/'H' contract)
+    native: bool         # implemented by native/kv_server.cpp (False =
+                         # native answers STATUS_ERROR and the client
+                         # degrades to per-key ops / no-op)
+    doc: str
+
+
+FORMATS: Tuple[WireFormat, ...] = (
+    WireFormat("PKV1", "kv-block", 1, "", False,
+               "KV block, payload only (bf16/f16/f32 pools): header + K + "
+               "V bytes. Pre-quantization stores keep decoding."),
+    WireFormat("PKV2", "kv-block", 2, "PKV1", False,
+               "Quantized KV block (--kv-cache-dtype int8): int8 K/V "
+               "payload + per-(slot, head) scale planes; ~0.52x bf16 "
+               "bytes, restores bit-identically."),
+    WireFormat("PKC1", "chain-envelope", 1, "", False,
+               "Prefix-chain envelope wrapping a PKV1/PKV2 blob with the "
+               "chain-parent's store key; bare payloads pass through "
+               "(chain-unaware servers round-trip it opaquely)."),
+    WireFormat("PDX1", "handoff-manifest", 1, "", False,
+               "Prefill->decode handoff manifest: JSON header + packed KV "
+               "block blobs (delete-after-consume lease)."),
+)
+
+OPS: Tuple[WireOp, ...] = (
+    WireOp("P", "put", False, True, True,
+           "Store one blob under a key (PKC1 envelopes declare the "
+           "chain parent)."),
+    WireOp("G", "get", False, False, True,
+           "Fetch one blob; refreshes its chain's LRU recency."),
+    WireOp("E", "exists", False, False, True,
+           "Key residency probe (single key)."),
+    WireOp("D", "delete", False, True, False,
+           "Remove a key — the disagg transfer lease's consume half."),
+    WireOp("M", "multi-get", True, False, False,
+           "Pipelined batch get: one round trip for a whole restore run."),
+    WireOp("I", "index-query", True, False, False,
+           "Residency bitmap for a key list; read-only and deliberately "
+           "NON-touching so router probes cannot keep cold chains warm."),
+    WireOp("H", "hot-chains", False, False, False,
+           "Hottest prefix chains root->leaf (prewarm discovery); "
+           "read-only like I."),
+    WireOp("T", "stats", False, False, True,
+           "Server stats as JSON."),
+)
+
+MAGICS = tuple(f.magic for f in FORMATS)
+OP_CODES = tuple(o.op for o in OPS)
+
+
+def format_for(magic: str):
+    for f in FORMATS:
+        if f.magic == magic:
+            return f
+    return None
+
+
+def op_for(code: str):
+    for o in OPS:
+        if o.op == code:
+            return o
+    return None
