@@ -188,6 +188,40 @@ def _scrape_handoff_metrics(url: str) -> dict:
 
 
 # --------------------------------------------------------------- stack mode
+def _arm_profile(engine_url: str, duration_s: float,
+                 trace_dir=None):
+    """POST /debug/profile to an engine (docs/OBSERVABILITY.md). Returns
+    the capture info dict, or a reason record when profiling is
+    unavailable — a bench with --profile never fails on the capture."""
+    import urllib.error
+    import urllib.request
+
+    body = {"duration_s": duration_s}
+    if trace_dir:
+        body["trace_dir"] = trace_dir
+    req = urllib.request.Request(
+        f"{engine_url}/debug/profile", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            info = json.loads(resp.read().decode("utf-8", "replace"))
+        print(f"device profiling armed on {engine_url}: "
+              f"{info.get('trace_dir')}", file=sys.stderr)
+        return {"engine": engine_url, **info}
+    except urllib.error.HTTPError as e:
+        reason = ("profiling unavailable (404)" if e.code == 404
+                  else f"profile arm failed: HTTP {e.code}")
+        print(f"--profile: {reason} on {engine_url}", file=sys.stderr)
+        return {"engine": engine_url, "status": "unavailable",
+                "reason": reason}
+    except OSError as e:
+        print(f"--profile: arm failed on {engine_url}: {e}",
+              file=sys.stderr)
+        return {"engine": engine_url, "status": "unavailable",
+                "reason": repr(e)}
+
+
 def bench_stack(args) -> dict:
     from benchmarks.multi_round_qa import (
         WorkloadConfig,
@@ -250,6 +284,7 @@ def bench_stack(args) -> dict:
         engine_env=engine_env,
         tensor_parallel_size=getattr(args, "tensor_parallel_size", 1),
     )
+    profile_info = None
     try:
         cfg = WorkloadConfig(
             base_url=stack.router_url,
@@ -269,6 +304,15 @@ def bench_stack(args) -> dict:
         warm = WorkloadConfig(**{**cfg.__dict__, "num_rounds": 2,
                                  "tag": "warmup"})
         asyncio.run(run_workload(warm))
+        # On-demand device profiling (docs/OBSERVABILITY.md): arm a
+        # bounded jax.profiler capture on the first engine right before
+        # the timed region, so this BENCH run carries a perfetto
+        # per-dispatch timeline alongside its numbers.
+        if getattr(args, "profile", 0):
+            profile_info = _arm_profile(
+                stack.engine_urls[0], float(args.profile),
+                getattr(args, "profile_trace_dir", None),
+            )
         # KV-hit parity (BASELINE target #3) is measured over the TIMED
         # region only: delta of the engines' prefix-cache hit/query token
         # counters around the workload.
@@ -315,6 +359,7 @@ def bench_stack(args) -> dict:
         # compile-cache telemetry — the cold-vs-warm A/B's recorded form.
         "engine_ready_seconds": ready_seconds,
         "engine_startup": startup,
+        **({"profile": profile_info} if profile_info else {}),
     }
 
 
@@ -790,6 +835,21 @@ def main():
                          "data:[DONE] — mid-stream engine kills must be "
                          "resumed, not truncated (docs/RESILIENCE.md; "
                          "pair with a kill_engine fault)")
+    ap.add_argument("--soak-require-anomaly-timelines", action="store_true",
+                    help="fail the soak if an SLO-missing request has no "
+                         "recorded flight-recorder timeline in the "
+                         "report's anomaly dump — every miss must be "
+                         "diagnosable (docs/OBSERVABILITY.md)")
+    ap.add_argument("--profile", type=float, default=0.0,
+                    help="arm a bounded jax.profiler capture of this many "
+                         "seconds on the first engine (POST "
+                         "/debug/profile) right before the timed "
+                         "workload; the JSON line records the perfetto "
+                         "trace dir under 'profile' "
+                         "(docs/OBSERVABILITY.md; 0 disables)")
+    ap.add_argument("--profile-trace-dir", default=None,
+                    help="trace directory for --profile (default: a "
+                         "fresh pstpu-profile-* tempdir on the engine)")
     ap.add_argument("--soak-output", default=None,
                     help="write the soak report JSON here (e.g. "
                          "BENCH_soak_r01.json) in addition to stdout")
@@ -856,6 +916,7 @@ def main():
         assert_soak_bars(
             report, args.soak_max_recovery,
             require_zero_truncation=args.soak_require_zero_truncation,
+            require_anomaly_timelines=args.soak_require_anomaly_timelines,
         )
         return 0
 
@@ -988,6 +1049,10 @@ def _result_line(args, res) -> dict:
         })
     if "disagg" in res:
         out["disagg"] = res["disagg"]
+    if "profile" in res:
+        # On-demand device capture (docs/OBSERVABILITY.md): where this
+        # run's perfetto trace landed (or why profiling was unavailable).
+        out["profile"] = res["profile"]
     return out
 
 

@@ -112,6 +112,10 @@ class RequestRecord:
     # the zero-5xx gate sees it too; this flag feeds the explicit
     # zero-truncation gate, docs/RESILIENCE.md).
     truncated: bool = False
+    # The router-echoed x-request-id: the handle the soak's anomaly dump
+    # uses to pull this request's flight-recorder timeline from the
+    # engines (GET /debug/requests/{id}, docs/OBSERVABILITY.md).
+    request_id: str = ""
 
     @property
     def ok(self) -> bool:
@@ -205,6 +209,7 @@ class UserSession:
         retry_after_hdr: Optional[str] = None
         sheds = 0
         truncated = False
+        request_id = ""
         while True:
             try:
                 async with http.post(
@@ -213,6 +218,8 @@ class UserSession:
                 ) as resp:
                     status = resp.status
                     retry_after_hdr = resp.headers.get("Retry-After")
+                    request_id = resp.headers.get("x-request-id",
+                                                  request_id)
                     if (status == 503 and retry_after_hdr is not None
                             and cfg.honor_retry_after
                             and sheds < cfg.max_shed_retries):
@@ -293,6 +300,7 @@ class UserSession:
             retry_after=retry_after_hdr is not None,
             slo_class=cfg.slo_class,
             truncated=truncated,
+            request_id=request_id,
         ))
 
     async def run(self, http: aiohttp.ClientSession, start_delay: float,

@@ -323,13 +323,17 @@ def build_report(*, model: str, backend: str, num_engines: int,
                  faults_scheduled: Optional[int] = None,
                  midstream_resumes: Optional[Dict[str, float]] = None,
                  elastic: Optional[list] = None,
+                 anomalies: Optional[List[dict]] = None,
                  ) -> dict:
     """Assemble + validate the soak report (pure; tests feed it synthetic
     rung/fault data). ``midstream_resumes`` is the router's
     router_midstream_resumes_total values by outcome, scraped at soak end.
     ``elastic`` carries the scale_out/scale_in event measurements
     (docs/ELASTIC.md): engine_ready_s, time_to_first_slo_met_token_s and
-    the joining engine's first-minute kv-hit rates."""
+    the joining engine's first-minute kv-hit rates. ``anomalies`` carries
+    the per-request flight-record dumps of every SLO-miss/error/truncation
+    (docs/OBSERVABILITY.md) — optional in the v1 schema so earlier
+    recorded artifacts still validate."""
     all_class = [c for rung in rungs for c in rung["classes"].values()]
     totals = {
         "requests": sum(c["requests"] for c in all_class),
@@ -369,6 +373,9 @@ def build_report(*, model: str, backend: str, num_engines: int,
         "autoscaler_gauges": autoscaler_gauges,
         "router_slo_attainment": slo_attainment_gauge or {},
         "elastic": elastic or [],
+        # Flight-record dumps for every SLO-miss/5xx/truncation
+        # (docs/OBSERVABILITY.md): chaos failures become diagnosable.
+        "anomalies": anomalies or [],
     }
     validate_report(report)
     return report
@@ -380,7 +387,8 @@ class SoakViolation(AssertionError):
 
 
 def assert_soak_bars(report: dict, max_recovery_s: float,
-                     require_zero_truncation: bool = False) -> None:
+                     require_zero_truncation: bool = False,
+                     require_anomaly_timelines: bool = False) -> None:
     """The chaos-gate acceptance bars (CI soak-smoke fails on these):
     zero client-visible 5xx/transport errors end-to-end, every SCHEDULED
     fault actually injected (a failed or dropped injection must not turn
@@ -391,7 +399,29 @@ def assert_soak_bars(report: dict, max_recovery_s: float,
     resume bar (docs/RESILIENCE.md): EVERY client stream ended in
     data:[DONE] — an engine SIGKILL mid-stream must have been spliced
     into a resumed continuation, not truncated. Opt-in because it is only
-    meaningful with >= 2 engines and resume enabled."""
+    meaningful with >= 2 engines and resume enabled.
+
+    ``require_anomaly_timelines`` enforces the observability bar
+    (docs/OBSERVABILITY.md): every SLO-missing request in the anomaly
+    dump carries a recorded flight-recorder timeline, so a miss is
+    diagnosable, not just counted. Scoped to slo_miss anomalies: an
+    errored/truncated request's engine may have died with its ring."""
+    if require_anomaly_timelines:
+        missing = [
+            a for a in report.get("anomalies", [])
+            if a.get("reason") == "slo_miss" and not a.get("timeline")
+            # A record that died with a restarted/killed engine is exempt
+            # (the recorder is process memory); everything else must have
+            # a timeline.
+            and a.get("timeline_expected", True)
+        ]
+        if missing:
+            raise SoakViolation(
+                f"{len(missing)} SLO-missing request(s) have no recorded "
+                f"flight timeline (first: "
+                f"{missing[0].get('request_id')!r}) — the observability "
+                f"plane must make every miss diagnosable"
+            )
     if require_zero_truncation and not report.get("zero_truncation", True):
         raise SoakViolation(
             f"zero-truncation bar violated: "
@@ -579,6 +609,100 @@ async def run_ladder(base_url: str, model: str,
         entry["recovery_ok"] = rec is not None and rec <= max_recovery_s
         entry.pop("injected_at", None)
     return rungs, fault_log, all_records
+
+
+# ------------------------------------------------------- anomaly dumps
+def _fetch_flight_record(engine_url: str, request_id: str):
+    """GET /debug/requests/{id} from one engine; None on 404/unreachable
+    (wrong engine, evicted record, debug disabled, engine restarted).
+    Keyed engines accept the shared VLLM_API_KEY (the discovery probe's
+    convention — /debug is auth-guarded)."""
+    import os
+    import urllib.error
+    import urllib.request
+
+    headers = {}
+    if os.environ.get("VLLM_API_KEY"):
+        headers["Authorization"] = f"Bearer {os.environ['VLLM_API_KEY']}"
+    req = urllib.request.Request(
+        f"{engine_url}/debug/requests/{request_id}", headers=headers,
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return json.loads(resp.read().decode("utf-8", "replace"))
+    except (urllib.error.HTTPError, OSError, ValueError):
+        return None
+
+
+def anomaly_reason(record, slo: SLOClass) -> Optional[str]:
+    """Why this record belongs in the anomaly dump (None = it doesn't):
+    truncated > error > slo_miss, mutually exclusive."""
+    if getattr(record, "truncated", False):
+        return "truncated"
+    if is_error(record):
+        return "error"
+    if record.ok and not slo.met(record):
+        return "slo_miss"
+    return None
+
+
+def collect_anomaly_records(records, classes: Sequence[SLOClass],
+                            engine_urls: Sequence[str],
+                            max_anomalies: int = 128,
+                            fetch=_fetch_flight_record,
+                            engine_death_cutoff: Optional[float] = None,
+                            ) -> List[dict]:
+    """Flight-record dumps for every SLO-missing/5xx/truncated request
+    (docs/OBSERVABILITY.md): each anomaly carries the client-side outcome
+    plus the engine-side timeline pulled from GET /debug/requests/{id}
+    across the stack's engines (first engine that recognizes the id
+    wins). Bounded at ``max_anomalies`` with the shortfall recorded on a
+    final marker entry — no silent caps.
+
+    ``engine_death_cutoff`` (monotonic, same clock as the records): the
+    flight recorder is process memory, so a request finished BEFORE the
+    last engine-death fault completed (restart/kill/scale-in) may have
+    lost its record with that engine; such anomalies are marked
+    ``timeline_expected: false`` and the require-anomaly-timelines gate
+    does not fail on them."""
+    by_class = {c.name: c for c in classes}
+    out: List[dict] = []
+    skipped = 0
+    for r in records:
+        slo = by_class.get(r.slo_class, classes[0]) if classes else None
+        reason = anomaly_reason(r, slo) if slo is not None else None
+        if reason is None:
+            continue
+        if len(out) >= max_anomalies:
+            skipped += 1
+            continue
+        entry = {
+            "request_id": getattr(r, "request_id", "") or None,
+            "reason": reason,
+            "slo_class": r.slo_class,
+            "status": r.status,
+            "ttft_s": round(r.ttft, 4),
+            "generation_tokens": r.generation_tokens,
+            "timeline_expected": bool(
+                engine_death_cutoff is None
+                or r.finish_time > engine_death_cutoff
+            ),
+            "engine": None,
+            "timeline": None,
+        }
+        if entry["request_id"]:
+            for url in engine_urls:
+                tl = fetch(url, entry["request_id"])
+                if tl is not None:
+                    entry["engine"] = url
+                    entry["timeline"] = tl
+                    break
+        out.append(entry)
+    if skipped:
+        out.append({"request_id": None, "reason": "capped",
+                    "skipped_anomalies": skipped, "engine": None,
+                    "timeline": None})
+    return out
 
 
 # --------------------------------------------------- stack-backed execution
@@ -1071,6 +1195,7 @@ def _run_soak_once(args, prewarm_top_k: int, ramp_in_s: float) -> dict:
             )
             asyncio.run(run_workload(warm))
 
+        ladder_t0 = time.monotonic()
         rungs, fault_log, _records = asyncio.run(run_ladder(
             stack.router_url, args.model, classes, ladder,
             args.soak_rung_duration,
@@ -1082,6 +1207,23 @@ def _run_soak_once(args, prewarm_top_k: int, ramp_in_s: float) -> dict:
         ))
         _finish_elastic_windows(elastic_log)
         metrics_text = _scrape_text(f"{stack.router_url}/metrics")
+        # Flight-record dumps BEFORE teardown: the engines' recorders die
+        # with their processes (docs/OBSERVABILITY.md anomaly dump).
+        # Requests finished before the last engine-death fault completed
+        # may have lost their records with that engine — marked, so the
+        # timelines gate stays honest through a restart/kill schedule.
+        death_cutoff = None
+        for entry in fault_log:
+            if entry["action"] in ("restart_engine", "kill_engine",
+                                   "scale_in_engine") and entry.get("ok"):
+                t = (ladder_t0 + entry["at_s"]
+                     + float(entry.get("downtime_s") or 0.0))
+                death_cutoff = t if death_cutoff is None \
+                    else max(death_cutoff, t)
+        anomalies = collect_anomaly_records(
+            _records, classes, list(stack.engine_urls),
+            engine_death_cutoff=death_cutoff,
+        )
     finally:
         if stack is not None:
             stack.terminate()
@@ -1102,6 +1244,7 @@ def _run_soak_once(args, prewarm_top_k: int, ramp_in_s: float) -> dict:
         slo_attainment_gauge=parse_slo_attainment(metrics_text),
         midstream_resumes=parse_midstream_resumes(metrics_text),
         elastic=elastic_log,
+        anomalies=anomalies,
     )
 
 

@@ -190,6 +190,15 @@ class EngineConfig:
     overlap_weight_load: bool = False
     # --- serving ---
     served_model_name: Optional[str] = None
+    # --- observability (docs/OBSERVABILITY.md) ---
+    # Per-request flight recorder + /debug endpoints (request timelines,
+    # on-demand device profiling). Recorder appends are O(1) in-memory
+    # list appends from the engine loop — no syscalls on the dispatch hot
+    # path — so this stays on by default; False removes the /debug surface
+    # entirely (plain 404) and records nothing.
+    debug_endpoints: bool = True
+    # Bounded ring: at most this many recent request timelines are kept.
+    flight_recorder_capacity: int = 256
 
     def __post_init__(self):
         # Speculative decoding is validated at CONFIG PARSE TIME so a

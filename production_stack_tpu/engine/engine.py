@@ -35,6 +35,9 @@ from production_stack_tpu.engine.tokenizer import (
 from production_stack_tpu.models.config import resolve_model_config
 from production_stack_tpu.parallel import make_mesh
 from production_stack_tpu.protocols import random_uuid
+from production_stack_tpu.tracing import (
+    spans_dropped_total as _spans_dropped_total,
+)
 from production_stack_tpu.utils import init_logger
 
 logger = init_logger(__name__)
@@ -195,9 +198,28 @@ class ServingEngine:
         self._last_fetch_done: Optional[float] = None
         # telemetry
         from production_stack_tpu.engine.metrics import (
+            LifecycleHistograms,
             RequestLatencyHistograms,
         )
 
+        # Per-request flight recorder (docs/OBSERVABILITY.md): a bounded
+        # in-memory ring of event timelines appended from the dispatch
+        # points below (O(1) list appends, no syscalls) and served at
+        # GET /debug/requests/{id}. None when --no-debug-endpoints.
+        self.recorder = None
+        if config.debug_endpoints:
+            from production_stack_tpu.engine.flight_recorder import (
+                FlightRecorder,
+            )
+
+            self.recorder = FlightRecorder(
+                capacity=config.flight_recorder_capacity
+            )
+        # Per-phase latency histograms (always on — pure in-memory
+        # observes): queue wait, prefill, decode trains, restores.
+        self.lifecycle = LifecycleHistograms()
+        self.scheduler.on_preempt = self._on_preempt
+        self.scheduler.on_restore = self._on_restore
         self.start_time = time.monotonic()
         self.prompt_tokens_total = 0
         self.generation_tokens_total = 0
@@ -390,6 +412,17 @@ class ServingEngine:
             state.sent = max(len(pre) - hold, 0)
         self._streams[request_id] = state
         self.scheduler.add_sequence(seq)
+        if self.recorder is not None:
+            self.recorder.start(
+                request_id, prompt_tokens=len(prompt_token_ids),
+            )
+            self.recorder.event(request_id, "enqueue", {
+                "prompt_tokens": len(prompt_token_ids),
+            })
+            if resume_tokens:
+                self.recorder.event(request_id, "resume", {
+                    "resume_tokens": len(resume_tokens),
+                })
         self.prompt_tokens_total += len(prompt_token_ids)
         self._new_work.set()
         try:
@@ -477,6 +510,14 @@ class ServingEngine:
         # Registered before the restore applies so a client disconnect while
         # queued aborts cleanly (scheduler.abort finds the sequence).
         self.scheduler.seqs[request_id] = seq
+        if self.recorder is not None:
+            self.recorder.start(
+                request_id, prompt_tokens=len(mani.prompt_token_ids),
+            )
+            self.recorder.event(request_id, "enqueue", {
+                "prompt_tokens": len(mani.prompt_token_ids),
+                "disagg_decode_hop": True,
+            })
         self._pending_restores.append((seq, mani))
         # prompt_tokens_total deliberately not bumped: the prefill engine
         # already counted this prompt (fleet-wide sums must not double-book
@@ -513,6 +554,89 @@ class ServingEngine:
         """Deferred abort: applied by the engine loop between device steps."""
         self._pending_aborts.add(request_id)
         self._new_work.set()
+
+    # ------------------------------------------------------- observability
+    def _on_preempt(self, request_id: str) -> None:
+        if self.recorder is not None:
+            self.recorder.event(request_id, "preempt")
+
+    def _on_restore(self, request_id: str, tokens: int,
+                    seconds: float) -> None:
+        # Shared-tier I/M restore round trip (docs/KV_ECONOMY.md pipeline):
+        # histogram + flight-record event, both from the engine loop.
+        self.lifecycle.restore_round_trip.observe(seconds)
+        if self.recorder is not None:
+            self.recorder.event(request_id, "restore", {
+                "tokens": tokens, "seconds": round(seconds, 6),
+            })
+
+    def _record_issue(self, batch, step: int, t_wall: float,
+                      t_mono: float) -> None:
+        """Dispatch-issue anchor: close each fresh row's queue-wait phase
+        and append the per-request issue event. O(rows) in-memory appends
+        on the engine loop — no syscalls (PL008-clean: host-side only).
+
+        ``t_wall``/``t_mono`` are captured BEFORE the runner's issue call:
+        a cold shape family compiles for seconds inside it, and that time
+        belongs to the dispatch's phase (issue -> fetch), not to an
+        unattributed gap between phases — the phase spans must tile the
+        request duration."""
+        rec = self.recorder
+        for idx, seq in enumerate(batch.seqs):
+            if seq.first_issue_time is None:
+                seq.first_issue_time = t_mono
+                self.lifecycle.queue_wait.observe(t_mono - seq.arrival_time)
+                if rec is not None:
+                    rec.event(seq.request_id, "schedule", {
+                        "wait_s": round(t_mono - seq.arrival_time, 6),
+                    }, t=t_wall)
+            if rec is None:
+                continue
+            if batch.kind == "prefill":
+                rec.event(seq.request_id, "prefill_issue", {
+                    "step": step, "chunk": batch.chunk_lens[idx],
+                    "start": batch.chunk_starts[idx],
+                }, t=t_wall)
+            else:
+                rec.event(seq.request_id, "decode_issue", {
+                    "step": step, "rows": len(batch.seqs),
+                    "k": batch.num_steps,
+                }, t=t_wall)
+
+    def _record_fetch(self, batch, step: int, token_lists,
+                      issue_time: float, spec_accepted_delta: int) -> None:
+        """Dispatch-fetch anchor: per-train decode cadence histogram +
+        per-request fetch events (tokens emitted, spec acceptance)."""
+        now = time.monotonic()
+        rec = self.recorder
+        if batch.kind == "decode":
+            self.lifecycle.decode_train.observe(now - issue_time)
+        for idx, seq in enumerate(batch.seqs):
+            if batch.kind == "prefill":
+                final = bool(batch.finals[idx]) if batch.finals else False
+                if final and seq.first_issue_time is not None:
+                    self.lifecycle.prefill.observe(
+                        now - seq.first_issue_time
+                    )
+                if rec is not None:
+                    rec.event(seq.request_id, "prefill_fetch", {
+                        "step": step, "final": final,
+                        "cached_tokens": seq.num_cached_tokens,
+                    })
+            elif rec is not None:
+                data = {
+                    "step": step,
+                    "tokens": len(token_lists[idx])
+                    if idx < len(token_lists) else 0,
+                    "ms": round((now - issue_time) * 1000, 2),
+                }
+                if spec_accepted_delta:
+                    # Explicitly BATCH-level: the device commits
+                    # acceptance per dispatch, not per row — summing this
+                    # across requests of one batch would overcount, so
+                    # the key says so.
+                    data["spec_accepted_batch"] = spec_accepted_delta
+                rec.event(seq.request_id, "decode_fetch", data)
 
     # ----------------------------------------------------------- fast-start
     async def prewarm(self, top_k: int = 8, max_blocks: int = 256) -> dict:
@@ -631,6 +755,8 @@ class ServingEngine:
                 # Another dispatch executes while this fetch blocks: the
                 # round-trip is hidden.
                 self.overlapped_fetches_total += 1
+            spec0 = (self.runner.spec_accepted_tokens_total
+                     if cfg.speculative_num_tokens else 0)
             try:
                 tokens, lps = await loop.run_in_executor(None, handle.fetch)
             except Exception:  # noqa: BLE001 — engine loop must survive
@@ -641,6 +767,11 @@ class ServingEngine:
             dlog("fetch", batch, step, extra=(
                 f" ms={(time.monotonic() - handle.issue_time) * 1000:.1f}"
             ))
+            self._record_fetch(
+                batch, step, tokens, handle.issue_time,
+                (self.runner.spec_accepted_tokens_total - spec0)
+                if cfg.speculative_num_tokens else 0,
+            )
             self.last_step_time = self._last_fetch_done = time.monotonic()
             produced, accepted = self.scheduler.apply_results(
                 batch, tokens, lps
@@ -686,6 +817,10 @@ class ServingEngine:
                     await drain()
                 step = self._step_counter
                 self._step_counter += 1
+                # Captured BEFORE the issue call: a cold-shape compile
+                # inside execute_async belongs to this dispatch's phase
+                # interval (see _record_issue).
+                issue_wall, issue_mono = time.time(), time.monotonic()
                 try:
                     # Issue in the executor: normally enqueue-only (~ms),
                     # but a cold shape family compiles for seconds and a
@@ -711,6 +846,7 @@ class ServingEngine:
                     self.prefill_dispatches_total += 1
                 self.scheduler.advance_at_issue(batch)
                 dlog("issue", batch, step)
+                self._record_issue(batch, step, issue_wall, issue_mono)
                 in_flight.append((batch, step, handle))
             if in_flight:
                 # Applying may finish rows and free blocks, unblocking
@@ -795,6 +931,11 @@ class ServingEngine:
                 seq.num_computed_tokens = mani.num_computed_tokens
                 seq.num_cached_tokens = mani.num_computed_tokens
                 seq.status = SequenceStatus.RUNNING
+                if self.recorder is not None:
+                    self.recorder.event(seq.request_id, "handoff_restore", {
+                        "blocks": mani.num_blocks,
+                        "tokens": mani.num_computed_tokens,
+                    })
                 self.scheduler.running.append(seq)
                 for i, tok in enumerate(mani.output_token_ids):
                     lp = None
@@ -858,6 +999,9 @@ class ServingEngine:
         except Exception:  # noqa: BLE001 — publish must fail cleanly
             logger.exception("KV handoff publish task failed")
             ok = False
+        if self.recorder is not None:
+            self.recorder.event(seq.request_id, "handoff_publish",
+                                {"ok": ok})
         # finish + emit run in ONE loop slice (no awaits), so the scheduler
         # never observes a half-finished handoff row.
         if not seq.status.is_finished:
@@ -909,6 +1053,14 @@ class ServingEngine:
             )
         if seq.status.is_finished:
             self._ttft_recorded.discard(seq.request_id)
+            if self.recorder is not None:
+                # Idempotent close of the flight record (stop-string
+                # finishes re-enter _process_output below with the status
+                # already terminal).
+                self.recorder.finish(
+                    seq.request_id, reason=seq.finish_reason(),
+                    output_tokens=len(seq.output_token_ids),
+                )
             # A finished sequence's speculative draft-ring slot goes back
             # to the free list (idempotent; no-op when spec is off).
             self.runner.release_spec_slot(seq.request_id)
@@ -981,6 +1133,14 @@ class ServingEngine:
                         seq.request_id, SequenceStatus.FINISHED_STOPPED
                     )
                 finished = True
+        if finished and self.recorder is not None:
+            # Stop-string finishes flip `finished` AFTER the top-of-method
+            # check ran; the recorder close is idempotent, so re-calling
+            # here covers both orders.
+            self.recorder.finish(
+                seq.request_id, reason=seq.finish_reason(),
+                output_tokens=len(seq.output_token_ids),
+            )
         if seq.handoff_key is not None:
             # Prefill-hop rows defer emission to _emit_handoff_output: the
             # detok/stop state above still advances (final_text for finished
@@ -1094,6 +1254,9 @@ class ServingEngine:
             "startup_cache_miss_families":
                 self.runner.startup_cache_miss_families,
             "num_preemptions": self.scheduler.num_preemptions_total,
+            # Observability plane (docs/OBSERVABILITY.md): OTLP exporter
+            # queue drops (0 with tracing off).
+            "trace_spans_dropped_total": _spans_dropped_total(),
             "prompt_tokens_total": self.prompt_tokens_total,
             "generation_tokens_total": self.generation_tokens_total,
             "decode_dispatches_total": self.decode_dispatches_total,

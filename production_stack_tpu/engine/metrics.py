@@ -13,7 +13,11 @@ state at scrape time (no sampling thread, no drift between gauges).
 import time
 from typing import TYPE_CHECKING, Iterable
 
-from prometheus_client.core import CounterMetricFamily, GaugeMetricFamily
+from prometheus_client.core import (
+    CounterMetricFamily,
+    GaugeMetricFamily,
+    HistogramMetricFamily,
+)
 from prometheus_client.registry import Collector
 
 if TYPE_CHECKING:
@@ -40,6 +44,21 @@ class EngineMetricsCollector(Collector):
             c = CounterMetricFamily(name[: -len("_total")], doc, labels=labels)
             c.add_metric(lv, value)
             return c
+
+        def histogram(name, doc, h):
+            # Cumulative buckets from the hand-rolled Histogram (or an
+            # all-zero family when the engine lacks the attribute — fakes).
+            fam = HistogramMetricFamily(name, doc, labels=labels)
+            if h is None:
+                fam.add_metric(lv, [("+Inf", 0)], 0.0)
+                return fam
+            buckets, cum = [], 0
+            for bound, c in zip(h.buckets, h.counts):
+                cum += c
+                buckets.append((str(bound), cum))
+            buckets.append(("+Inf", h.count))
+            fam.add_metric(lv, buckets, h.sum)
+            return fam
 
         sched = eng.scheduler
         bm = eng.block_manager
@@ -162,6 +181,34 @@ class EngineMetricsCollector(Collector):
                       "Cumulative host-observed time with NO dispatch "
                       "outstanding between two dispatches (pipeline bubble)",
                       eng.dispatch_gap_seconds_total)
+        # Request-lifecycle phase histograms (docs/OBSERVABILITY.md):
+        # where a request's latency went — queue wait, prefill, per-train
+        # decode cadence, shared-tier restore round trips. The text
+        # renderer exports the same four series (PL004 keeps them aligned).
+        lc = getattr(eng, "lifecycle", None)
+        yield histogram("pstpu:queue_wait_seconds",
+                        "Arrival to first dispatch issue per request",
+                        getattr(lc, "queue_wait", None))
+        yield histogram("pstpu:prefill_seconds",
+                        "First prefill issue to final prefill chunk fetch "
+                        "per request",
+                        getattr(lc, "prefill", None))
+        yield histogram("pstpu:decode_train_seconds",
+                        "Issue-to-fetch duration of each fused decode "
+                        "dispatch (train)",
+                        getattr(lc, "decode_train", None))
+        yield histogram("pstpu:restore_round_trip_seconds",
+                        "Duration of each shared-tier I/M restore round "
+                        "trip that restored KV blocks",
+                        getattr(lc, "restore_round_trip", None))
+        # Exporter hygiene (docs/OBSERVABILITY.md): spans the OTLP queue
+        # had to drop — tracing never blocks serving, but never silently.
+        from production_stack_tpu.tracing import spans_dropped_total
+
+        yield counter("pstpu:trace_spans_dropped_total",
+                      "OTLP spans dropped because the exporter queue was "
+                      "full",
+                      spans_dropped_total())
         # Prefill/decode disaggregation telemetry — the text renderer
         # (server/metrics.py) exports the same series; keeping the two
         # renderers aligned is enforced by pstpu-lint PL004.
@@ -304,5 +351,51 @@ class RequestLatencyHistograms:
             + self.e2e.render(
                 "vllm:e2e_request_latency_seconds",
                 "End-to-end request latency", label,
+            )
+        )
+
+
+# Sub-second buckets for the per-dispatch phases (a decode train or a
+# restore round trip is milliseconds-to-seconds, never minutes).
+PHASE_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0,
+)
+
+
+class LifecycleHistograms:
+    """Per-phase request-lifecycle latency histograms
+    (docs/OBSERVABILITY.md): queue wait (arrival -> first issue), prefill
+    (first issue -> final chunk fetch), per-train decode cadence
+    (issue -> fetch of each fused decode dispatch), and shared-tier
+    restore round trips. Observed from the engine loop's dispatch points —
+    the same anchor events the flight recorder records."""
+
+    def __init__(self):
+        self.queue_wait = Histogram(TTFT_BUCKETS)
+        self.prefill = Histogram(TTFT_BUCKETS)
+        self.decode_train = Histogram(PHASE_BUCKETS)
+        self.restore_round_trip = Histogram(PHASE_BUCKETS)
+
+    def render(self, label: str) -> list:
+        return (
+            self.queue_wait.render(
+                "pstpu:queue_wait_seconds",
+                "Arrival to first dispatch issue per request", label,
+            )
+            + self.prefill.render(
+                "pstpu:prefill_seconds",
+                "First prefill issue to final prefill chunk fetch per "
+                "request", label,
+            )
+            + self.decode_train.render(
+                "pstpu:decode_train_seconds",
+                "Issue-to-fetch duration of each fused decode dispatch "
+                "(train)", label,
+            )
+            + self.restore_round_trip.render(
+                "pstpu:restore_round_trip_seconds",
+                "Duration of each shared-tier I/M restore round trip that "
+                "restored KV blocks", label,
             )
         )

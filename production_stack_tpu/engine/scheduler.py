@@ -130,6 +130,11 @@ class Sequence:
     # generation_tokens_total (the original engine counted them).
     resume_base: int = 0
     _resume_counted: bool = False
+    # --- observability (docs/OBSERVABILITY.md) ---
+    # Monotonic time of this sequence's FIRST dispatch issue: closes the
+    # queue-wait phase (pstpu:queue_wait_seconds observes
+    # first_issue_time - arrival_time exactly once, in the engine loop).
+    first_issue_time: Optional[float] = None
 
     @property
     def hash_seed(self) -> bytes:
@@ -211,6 +216,13 @@ class Scheduler:
         # unconditionally). Held as the Sequence itself, not an index — the
         # running list churns between dispatches (advisor r3 finding).
         self._decode_first: Optional[Sequence] = None
+        # Observability hooks (docs/OBSERVABILITY.md), set by the engine:
+        # on_preempt(request_id) at each preemption; on_restore(request_id,
+        # restored_tokens, seconds) after a shared-tier restore round trip.
+        # Plain callables invoked synchronously on the engine loop — None
+        # keeps the scheduler hook-free (tests construct it standalone).
+        self.on_preempt = None
+        self.on_restore = None
 
     def _window_ok(self, rows: int, max_blocks: int, budget: int) -> bool:
         # Mirrors the runner's windowed-dispatch mb quantization
@@ -321,12 +333,18 @@ class Scheduler:
                 if self.offload is not None:
                     # Host/remote KV tiers may extend the cached prefix past
                     # what survived in device HBM (LMCache-equivalent path).
+                    t_restore = time.monotonic()
                     restored = self.offload.try_restore(
                         cand.all_token_ids, cand.block_ids,
                         cand.num_computed_tokens, seed=cand.hash_seed,
                     )
                     cand.num_computed_tokens += restored
                     cand.num_cached_tokens += restored
+                    if restored and self.on_restore is not None:
+                        self.on_restore(
+                            cand.request_id, restored,
+                            time.monotonic() - t_restore,
+                        )
             cands.append(cand)
         if not cands:
             return None
@@ -558,6 +576,8 @@ class Scheduler:
         logger.warning("Preempting request %s (recompute)", seq.request_id)
         self.num_preemptions_total += 1
         seq.num_preemptions += 1
+        if self.on_preempt is not None:
+            self.on_preempt(seq.request_id)
         if seq in self.running:
             self.running.remove(seq)
         self.block_manager.free_blocks(seq.block_ids)

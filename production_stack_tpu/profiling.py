@@ -1,0 +1,120 @@
+"""On-demand device profiling: ``POST /debug/profile`` arms
+``jax.profiler.trace`` for a bounded window (docs/OBSERVABILITY.md).
+
+The roofline work (docs/PERF.md) attributes host gaps vs device time from
+aggregate counters; a perfetto capture is the per-dispatch timeline that
+settles the attribution. One capture at a time, bounded duration, and
+404-clean when profiling is unavailable (jax.profiler missing or debug
+endpoints disabled) — production routers probing /debug must see a plain
+404, never a crash.
+"""
+
+import asyncio
+import os
+import tempfile
+import time
+from typing import Optional
+
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+MAX_CAPTURE_SECONDS = 300.0
+
+
+class ProfilerBusy(RuntimeError):
+    """A capture is already in flight (one at a time — overlapping
+    jax.profiler.start_trace calls abort the first capture)."""
+
+
+class DeviceProfiler:
+    """Arms jax.profiler.trace for a bounded window and stops it from a
+    scheduled task, so a forgotten capture can never run forever."""
+
+    def __init__(self, default_dir: Optional[str] = None):
+        self.default_dir = default_dir
+        self.active: Optional[dict] = None
+        self.last: Optional[dict] = None
+        # The stop task handle is kept (and cancelled on close) so the
+        # bounded window survives handler returns without leaking a task.
+        self._stop_task: Optional[asyncio.Task] = None
+
+    @staticmethod
+    def available() -> bool:
+        try:
+            import jax.profiler  # noqa: F401 — availability probe
+        except Exception:  # noqa: BLE001 — any import failure = unavailable
+            return False
+        import jax.profiler as jp
+
+        return hasattr(jp, "start_trace") and hasattr(jp, "stop_trace")
+
+    async def arm(self, duration_s: float,
+                  trace_dir: Optional[str] = None) -> dict:
+        """Start a capture; a background task stops it after
+        ``duration_s``. Raises ProfilerBusy while one is in flight."""
+        import jax.profiler as jp
+
+        if self.active is not None:
+            raise ProfilerBusy(
+                f"a capture into {self.active['trace_dir']!r} is already "
+                f"running"
+            )
+        duration_s = min(max(0.1, float(duration_s)), MAX_CAPTURE_SECONDS)
+        trace_dir = trace_dir or self.default_dir or tempfile.mkdtemp(
+            prefix="pstpu-profile-"
+        )
+        os.makedirs(trace_dir, exist_ok=True)
+        jp.start_trace(trace_dir)
+        self.active = {
+            "trace_dir": trace_dir,
+            "duration_s": duration_s,
+            "started_at": time.time(),
+        }
+        self._stop_task = asyncio.get_running_loop().create_task(
+            self._stop_after(duration_s)
+        )
+        logger.info("Device profiling armed: dir=%s duration=%.1fs",
+                    trace_dir, duration_s)
+        return dict(self.active)
+
+    async def _stop_after(self, duration_s: float) -> None:
+        try:
+            await asyncio.sleep(duration_s)
+        finally:
+            self._finish_capture()
+
+    def _finish_capture(self) -> None:
+        if self.active is None:
+            return
+        import jax.profiler as jp
+
+        info = self.active
+        self.active = None
+        try:
+            jp.stop_trace()
+        except Exception:  # noqa: BLE001 — a failed stop must not wedge arm
+            logger.exception("jax.profiler.stop_trace failed")
+            info = {**info, "error": "stop_trace failed"}
+        info = {**info, "stopped_at": time.time()}
+        self.last = info
+        logger.info("Device profiling capture complete: %s",
+                    info["trace_dir"])
+
+    def status(self) -> dict:
+        return {
+            "available": self.available(),
+            "active": dict(self.active) if self.active else None,
+            "last": dict(self.last) if self.last else None,
+        }
+
+    async def close(self) -> None:
+        """Stop any in-flight capture (engine shutdown)."""
+        task, self._stop_task = self._stop_task, None
+        if task is not None and not task.done():
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+        self._finish_capture()

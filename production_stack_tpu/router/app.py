@@ -493,6 +493,13 @@ def build_app(args) -> web.Application:
             timeout=aiohttp.ClientTimeout(total=None, sock_connect=30),
             connector=aiohttp.TCPConnector(limit=0),  # unlimited, like ref
         )
+        # Exporter hygiene (docs/OBSERVABILITY.md): queue-full span drops
+        # feed router_trace_spans_dropped_total instead of vanishing.
+        from production_stack_tpu.tracing import get_tracer
+
+        tracer = get_tracer("pstpu-router")
+        if tracer is not None:
+            tracer.on_drop = metrics.router_trace_spans_dropped_total.inc
         proc = app.get("batch_processor")
         if proc is not None:
             proc.start()

@@ -109,6 +109,14 @@ router_truncations_total = Counter(
     "Client streams that ended without data: [DONE] (mid-stream failure "
     "not resumed, resume budget exhausted, or mid-stream deadline)", [],
 )
+# Observability plane (docs/OBSERVABILITY.md): OTLP spans the router's
+# exporter queue had to drop — tracing never blocks serving, but an
+# undersized exporter must be visible. Bumped by the Tracer's on_drop hook
+# (wired in app.build_app).
+router_trace_spans_dropped_total = Counter(
+    "router_trace_spans_dropped",
+    "OTLP spans dropped because the exporter queue was full", [],
+)
 # Autoscaling signals (docs/SOAK.md): the first-class gauges an HPA /
 # prometheus-adapter pipeline targets, so helm autoscaling wiring is a
 # values-only change. Refreshed by the router's /metrics handler from the

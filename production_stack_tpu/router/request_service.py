@@ -50,7 +50,7 @@ from production_stack_tpu.router.stats import (
     get_request_stats_monitor,
 )
 from production_stack_tpu.protocols import ErrorResponse, random_uuid
-from production_stack_tpu.tracing import get_tracer
+from production_stack_tpu.tracing import SPAN_KIND_CLIENT, get_tracer
 from production_stack_tpu.utils import init_logger
 
 logger = init_logger(__name__)
@@ -229,13 +229,16 @@ async def route_general_request(
                      (route_time - in_time) * 1e3, attempt)
         # One span per routed attempt (when tracing is enabled); its context
         # propagates to the engine via the W3C traceparent header (reference
-        # tutorials/12-distributed-tracing.md).
+        # tutorials/12-distributed-tracing.md). CLIENT kind: this is the
+        # router's OUTBOUND proxy hop, and retry/failover/resume outcomes
+        # land on it as span events (docs/OBSERVABILITY.md).
         span_cm = contextlib.nullcontext() if tracer is None else tracer.span(
             f"router.route {endpoint}",
             parent=request.headers.get("traceparent"),
             attributes={"backend": backend_url, "model": model,
                         "request.id": request_id, "attempt": attempt,
                         "queueing.delay_ms": (route_time - in_time) * 1e3},
+            kind=SPAN_KIND_CLIENT,
         )
         try:
             with span_cm as span:
@@ -247,7 +250,7 @@ async def route_general_request(
                     # Mid-stream resume (docs/RESILIENCE.md): the relay can
                     # re-route an interrupted stream's continuation through
                     # the same candidate pool / routing policy.
-                    endpoints=endpoints, tried=tried,
+                    endpoints=endpoints, tried=tried, span=span,
                 )
         except DeadlineExceeded as e:
             metrics.router_deadline_exceeded_total.labels(
@@ -475,6 +478,7 @@ async def proxy_request(
     extra_headers: Optional[dict] = None,
     endpoints=None,
     tried: Optional[set] = None,
+    span=None,
 ) -> web.StreamResponse:
     """Stream the backend response through to the client.
 
@@ -521,11 +525,23 @@ async def proxy_request(
         monitor.on_request_complete(backend_url, request_id, time.time())
         if resilience is not None:
             resilience.record_failure(backend_url)
+        if span is not None:
+            # The failure rides the attempt's span as an event, so a trace
+            # shows WHY this hop retried/failed over instead of a bare
+            # error status (docs/OBSERVABILITY.md).
+            span.add_event("prestream_failure", {
+                "backend": backend_url, "reason": reason,
+                **({"status": status} if status is not None else {}),
+            })
         logger.warning("Proxy to %s failed pre-stream: %s", backend_url, reason)
         return PreStreamFailure(backend_url, reason, status=status)
 
     def _deadline(kind: str) -> DeadlineExceeded:
         monitor.on_request_complete(backend_url, request_id, time.time())
+        if span is not None:
+            span.add_event("deadline_exceeded", {
+                "backend": backend_url, "kind": kind,
+            })
         logger.warning("Request %s %s deadline exceeded at %s",
                        request_id, kind, backend_url)
         return DeadlineExceeded(kind, backend_url)
@@ -812,6 +828,12 @@ async def proxy_request(
                                                 time.time())
                     entry_open = False
                     cur_resp.close()
+                    if span is not None:
+                        span.add_event("midstream_failure", {
+                            "backend": cur_url,
+                            "events_relayed": parser.events_relayed,
+                            "reason": repr(e.__cause__ or e),
+                        })
                     logger.warning(
                         "Proxy to %s failed mid-stream after %d relayed "
                         "event(s): %s", cur_url, parser.events_relayed,
@@ -856,10 +878,18 @@ async def proxy_request(
                     if attach is None:
                         metrics.router_midstream_resumes_total.labels(
                             outcome="failed").inc()
+                        if span is not None:
+                            span.add_event("midstream_resume",
+                                           {"outcome": "failed"})
                         truncated = True
                         break
                     metrics.router_midstream_resumes_total.labels(
                         outcome="resumed").inc()
+                    if span is not None:
+                        span.add_event("midstream_resume", {
+                            "outcome": "resumed", "backend": attach[0],
+                            "token_offset": len(parser.delivered),
+                        })
                     logger.info(
                         "Request %s resumed on %s at token offset %d "
                         "(resume %d/%d)", request_id, attach[0],
@@ -886,6 +916,8 @@ async def proxy_request(
                 parser.done = True
             if truncated:
                 metrics.router_truncations_total.inc()
+                if span is not None:
+                    span.add_event("truncated", {"backend": cur_url})
             if entry_open:
                 monitor.on_request_complete(cur_url, request_id, time.time())
                 entry_open = False

@@ -89,6 +89,14 @@ class APIServer:
         self._drained = asyncio.Event()
         self._drain_task: Optional[asyncio.Task] = None
         self.on_drained = None   # callable run after drain (main: exit loop)
+        # On-demand device profiling (docs/OBSERVABILITY.md): POST
+        # /debug/profile arms jax.profiler.trace for a bounded window.
+        # None when the debug surface is disabled — /debug/* then 404s.
+        self.profiler = None
+        if engine.config.debug_endpoints:
+            from production_stack_tpu.profiling import DeviceProfiler
+
+            self.profiler = DeviceProfiler()
 
     @property
     def draining(self) -> bool:
@@ -171,6 +179,12 @@ class APIServer:
                 attributes={"http.method": request.method,
                             "model": self.model_name},
             ) as span:
+                # Exposed to _generate_response so the per-request phase
+                # tree (queue-wait/prefill/decode/restore, rebuilt from
+                # the flight recorder at stream end) parents under THIS
+                # span — one trace covers client -> router -> engine
+                # phases (docs/OBSERVABILITY.md).
+                request["pstpu_trace_span"] = span
                 resp = await handler(request)
                 span.attributes["http.status_code"] = getattr(
                     resp, "status", 0
@@ -179,8 +193,12 @@ class APIServer:
 
         @web.middleware
         async def auth(request: web.Request, handler):
+            # /debug is guarded too: request timelines leak prompt sizes
+            # and POST /debug/profile arms device profiling — neither may
+            # be reachable unauthenticated on a keyed engine.
             if self.api_key and (request.path.startswith("/v1")
                                  or request.path.startswith("/disagg")
+                                 or request.path.startswith("/debug")
                                  or request.path == "/rerank"):
                 import hmac
 
@@ -221,6 +239,8 @@ class APIServer:
             await self.engine.start()
 
         async def on_cleanup(app):
+            if self.profiler is not None:
+                await self.profiler.close()
             await self.engine.stop()
             from production_stack_tpu.tracing import reset_tracer
 
@@ -240,7 +260,123 @@ class APIServer:
         app.router.add_get("/prefix_index", self.prefix_index)
         app.router.add_post("/prewarm", self.prewarm)
         app.router.add_get("/version", self.version)
+        if self.engine.config.debug_endpoints:
+            # Observability plane (docs/OBSERVABILITY.md). Unregistered
+            # when disabled, so /debug/* is a plain 404 — probes cannot
+            # tell a debug-off engine from a path that never existed.
+            app.router.add_get("/debug/requests/{request_id}",
+                               self.debug_request)
+            app.router.add_get("/debug/timeline", self.debug_timeline)
+            app.router.add_post("/debug/profile", self.debug_profile_start)
+            app.router.add_get("/debug/profile", self.debug_profile_status)
         return app
+
+    # ------------------------------------------------- observability (debug)
+    async def debug_request(self, request: web.Request) -> web.Response:
+        """GET /debug/requests/{id}: one request's recorded flight
+        timeline (engine-internal id, the client-facing x-request-id, or
+        the OpenAI response id all resolve)."""
+        rec = self.engine.recorder
+        if rec is None:
+            return _error(404, "Flight recorder disabled "
+                               "(--no-debug-endpoints)", etype="not_found")
+        found = rec.get(request.match_info["request_id"])
+        if found is None:
+            return _error(
+                404,
+                f"No flight record for "
+                f"{request.match_info['request_id']!r} (evicted from the "
+                f"ring, or never served by this engine)",
+                etype="not_found",
+            )
+        return web.json_response(found)
+
+    async def debug_timeline(self, request: web.Request) -> web.Response:
+        """GET /debug/timeline: most-recent request summaries across the
+        whole ring (newest first)."""
+        rec = self.engine.recorder
+        if rec is None:
+            return _error(404, "Flight recorder disabled "
+                               "(--no-debug-endpoints)", etype="not_found")
+        try:
+            # Clamped both ways: a 0/negative value must mean "none", not
+            # invert the slice bound into "everything".
+            max_requests = min(
+                max(0, int(request.query.get("max_requests", 64))), 1024
+            )
+        except ValueError:
+            return _error(400, "max_requests must be an integer")
+        return web.json_response(rec.timeline(max_requests))
+
+    async def debug_profile_start(self, request: web.Request) -> web.Response:
+        """POST /debug/profile: arm jax.profiler.trace for a bounded
+        window (perfetto trace dir; one capture at a time; 404-clean when
+        profiling is unavailable)."""
+        if self.profiler is None or not self.profiler.available():
+            return _error(404, "Device profiling unavailable",
+                          etype="not_found")
+        raw = await request.read()
+        try:
+            body = json.loads(raw) if raw else {}
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return _error(400, "Request body is not valid JSON")
+        duration = body.get("duration_s", 5.0)
+        if isinstance(duration, bool) or not isinstance(
+            duration, (int, float)
+        ) or not 0 < float(duration) <= 300:
+            return _error(400, "'duration_s' must be a number in (0, 300]")
+        trace_dir = body.get("trace_dir")
+        if trace_dir is not None and not isinstance(trace_dir, str):
+            return _error(400, "'trace_dir' must be a string path")
+        from production_stack_tpu.profiling import ProfilerBusy
+
+        try:
+            info = await self.profiler.arm(float(duration),
+                                           trace_dir=trace_dir)
+        except ProfilerBusy as e:
+            return _error(409, str(e), etype="conflict")
+        except Exception as e:  # noqa: BLE001 — capture start must not 500
+            logger.exception("Device profiling arm failed")
+            return _error(503, f"Profiler failed to start: {e}",
+                          etype="service_unavailable")
+        return web.json_response({"status": "armed", **info})
+
+    async def debug_profile_status(self,
+                                   request: web.Request) -> web.Response:
+        if self.profiler is None:
+            return _error(404, "Device profiling unavailable",
+                          etype="not_found")
+        return web.json_response(self.profiler.status())
+
+    def _emit_lifecycle_spans(self, request: web.Request,
+                              request_ids) -> None:
+        """Export each child request's phase tree (from the flight
+        recorder) as OTLP spans under the middleware's server span — the
+        engine's contribution to the one-trace-per-request story. No-op
+        without tracing or a recorder (None checks only)."""
+        span = request.get("pstpu_trace_span")
+        rec = self.engine.recorder
+        if span is None or rec is None:
+            return
+        from production_stack_tpu.tracing import get_tracer
+
+        tracer = get_tracer("pstpu-engine")
+        if tracer is None:
+            return
+        for rid in request_ids:
+            found = rec.get(rid)
+            if not found:
+                continue
+            for record in found["records"]:
+                for phase in record.get("phases", ()):
+                    if phase["end"] < phase["start"]:
+                        continue  # clock skew guard; zero-length is valid
+                    tracer.record_span(
+                        f"engine.{phase['name']}",
+                        parent=span.traceparent,
+                        start_s=phase["start"], end_s=phase["end"],
+                        attributes={"request.id": rid, **phase["attrs"]},
+                    )
 
     # ------------------------------------------------------------- embeddings
     async def embeddings(self, request: web.Request) -> web.Response:
@@ -844,6 +980,17 @@ class APIServer:
             for p_idx, prompt in enumerate(prompts)
             for c_idx in range(n)
         ]
+        child_rids = [rid for *_rest, rid in children]
+        if self.engine.recorder is not None:
+            # The router-visible x-request-id and the OpenAI response id
+            # both resolve to the engine-internal child ids, so
+            # GET /debug/requests/{id} works with whichever id the caller
+            # holds (docs/OBSERVABILITY.md).
+            ext = request.headers.get("x-request-id")
+            if ext:
+                self.engine.recorder.alias(ext, child_rids)
+            if request_id != child_rids[0]:
+                self.engine.recorder.alias(request_id, child_rids)
 
         # Mid-stream resume (docs/RESILIENCE.md): the router re-issues an
         # interrupted request with the already-delivered output token ids
@@ -1126,6 +1273,7 @@ class APIServer:
             finally:
                 for t in tasks:
                     t.cancel()
+            self._emit_lifecycle_spans(request, child_rids)
             await response.write_eof()
             return response
 
@@ -1189,6 +1337,7 @@ class APIServer:
                     "logprobs": self._completion_logprobs(final),
                 }
             choices.append(choice)
+        self._emit_lifecycle_spans(request, child_rids)
         return web.json_response({
             "id": request_id,
             "object": object_name,
@@ -1252,6 +1401,7 @@ def build_engine_from_args(args: argparse.Namespace) -> ServingEngine:
         role=args.role,
         **({"kv_remote_url": args.kv_remote_url}
            if args.kv_remote_url else {}),
+        debug_endpoints=not args.no_debug_endpoints,
     )
     return ServingEngine(cfg)
 
@@ -1377,6 +1527,13 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="shed new generation requests with 503 + "
                         "Retry-After while the wait queue is at least this "
                         "deep (0 disables)")
+    p.add_argument("--no-debug-endpoints", action="store_true",
+                   help="disable the /debug observability surface "
+                        "(per-request flight-recorder timelines at "
+                        "/debug/requests/{id} + /debug/timeline and "
+                        "on-demand jax.profiler captures at "
+                        "/debug/profile) — /debug/* then 404s and nothing "
+                        "is recorded (docs/OBSERVABILITY.md)")
     return p.parse_args(argv)
 
 

@@ -32,7 +32,8 @@ def render_engine_metrics(engine: "ServingEngine", model_name: str) -> str:
                 "startup_weight_load_seconds", "startup_compile_seconds",
                 "startup_warmup_seconds", "startup_prewarm_seconds",
                 "startup_total_seconds", "startup_cache_hit_families",
-                "startup_cache_miss_families"):
+                "startup_cache_miss_families",
+                "trace_spans_dropped_total"):
         s.setdefault(key, 0)
     s.setdefault("disagg_role", "unified")
     s.setdefault("kv_cache_dtype", "bfloat16")
@@ -201,6 +202,16 @@ def render_engine_metrics(engine: "ServingEngine", model_name: str) -> str:
         "# TYPE pstpu:dispatch_gap_seconds_total counter",
         f"pstpu:dispatch_gap_seconds_total{label} "
         f"{s['dispatch_gap_seconds_total']:.6f}",
+        # Observability plane (docs/OBSERVABILITY.md): OTLP spans the
+        # exporter queue had to drop — tracing never blocks serving, but
+        # never silently either (the collector renders the same series;
+        # the lifecycle phase histograms render below with the TTFT/e2e
+        # distributions).
+        "# HELP pstpu:trace_spans_dropped_total OTLP spans dropped because "
+        "the exporter queue was full",
+        "# TYPE pstpu:trace_spans_dropped_total counter",
+        f"pstpu:trace_spans_dropped_total{label} "
+        f"{s['trace_spans_dropped_total']}",
         # Prefill/decode disaggregation (docs/DISAGG.md): the engine's role
         # (the router's DisaggRouter reads it to build pools) and the KV
         # handoff plane's transfer telemetry — publishes on prefill
@@ -269,4 +280,10 @@ def render_engine_metrics(engine: "ServingEngine", model_name: str) -> str:
     hists = getattr(engine, "histograms", None)
     if hists is not None:
         lines += hists.render(label)
+    # Request-lifecycle phase histograms (docs/OBSERVABILITY.md): queue
+    # wait / prefill / decode-train / restore round trip — the "where did
+    # the latency go" split the Grafana lifecycle row charts.
+    lifecycle = getattr(engine, "lifecycle", None)
+    if lifecycle is not None:
+        lines += lifecycle.render(label)
     return "\n".join(lines) + "\n"

@@ -118,7 +118,25 @@ class FakeEngine:
         self.straggler_jitter = 0.0
 
     def build_app(self) -> web.Application:
-        app = web.Application()
+        @web.middleware
+        async def trace(request, handler):
+            # Same contract as the real engine's trace middleware
+            # (server/api_server.py): continue the router's trace from the
+            # propagated W3C traceparent header. No-op unless the test
+            # process enabled tracing via OTEL_EXPORTER_OTLP_ENDPOINT.
+            from production_stack_tpu.tracing import get_tracer
+
+            tracer = get_tracer("pstpu-engine")
+            if tracer is None or not request.path.startswith("/v1"):
+                return await handler(request)
+            with tracer.span(
+                f"engine {request.path}",
+                parent=request.headers.get("traceparent"),
+                attributes={"model": self.model},
+            ):
+                return await handler(request)
+
+        app = web.Application(middlewares=[trace])
         app.router.add_post("/v1/chat/completions", self.chat)
         app.router.add_post("/v1/completions", self.completions)
         app.router.add_get("/v1/models", self.models)

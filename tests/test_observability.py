@@ -27,7 +27,10 @@ def _exported_series():
         prefix_hits_total = 3
         prefix_queries_total = 7
 
-    from production_stack_tpu.engine.metrics import RequestLatencyHistograms
+    from production_stack_tpu.engine.metrics import (
+        LifecycleHistograms,
+        RequestLatencyHistograms,
+    )
 
     class _FakeEngine:
         scheduler = _FakeSched()
@@ -35,6 +38,7 @@ def _exported_series():
         prompt_tokens_total = 10
         generation_tokens_total = 20
         histograms = RequestLatencyHistograms()
+        lifecycle = LifecycleHistograms()
 
         def stats(self):
             return {
@@ -49,11 +53,15 @@ def _exported_series():
 
     text = render_engine_metrics(_FakeEngine(), "m")
     series = set(re.findall(r"^((?:vllm|pstpu):[a-z_]+)", text, re.M))
-    # Router series from its gauge registry.
+    # Router series from its gauge registry. prometheus_client appends
+    # _total to Counter names, so both spellings count as exported.
     from production_stack_tpu.router import metrics as router_metrics
 
     src = open(router_metrics.__file__).read()
-    series |= set(re.findall(r'"((?:vllm:|pstpu:|router_)[a-z_]+)"', src))
+    declared = set(re.findall(r'"((?:vllm:|pstpu:|router_)[a-z_]+)"', src))
+    series |= declared
+    series |= {f"{name}_total" for name in declared
+               if not name.endswith("_total")}
     return series
 
 
@@ -87,6 +95,18 @@ def test_dashboard_queries_name_exported_series():
     assert {"pstpu:kv_shared_tier_hits_total",
             "pstpu:kv_shared_tier_misses_total",
             "router_backend_kv_hit_rate"} <= all_series
+    # Request-lifecycle row (docs/OBSERVABILITY.md): the per-phase
+    # histograms and the spans-dropped counters are charted, not just
+    # exported.
+    assert {"pstpu:queue_wait_seconds_bucket",
+            "pstpu:prefill_seconds_bucket",
+            "pstpu:decode_train_seconds_bucket",
+            "pstpu:restore_round_trip_seconds_bucket",
+            "pstpu:trace_spans_dropped_total",
+            "router_trace_spans_dropped_total"} <= all_series
+    lifecycle_titles = [p["title"] for p in dash["panels"]
+                        if p["title"].startswith("Request lifecycle")]
+    assert len(lifecycle_titles) >= 3, lifecycle_titles
 
 
 def test_prom_adapter_rule_names_exported_series():
@@ -178,6 +198,92 @@ def test_request_stats_monitor_feeds_histograms():
         f'vllm:router_e2e_latency_seconds_count{{server="{url}"}} 1.0'
         in scraped
     )
+
+
+def test_lifecycle_histograms_render_on_both_surfaces():
+    """The four pstpu lifecycle phase histograms render with cumulative
+    buckets on the text renderer AND the prometheus_client collector
+    (docs/OBSERVABILITY.md; PL004 keeps the surfaces aligned)."""
+    from production_stack_tpu.engine.metrics import LifecycleHistograms
+    from production_stack_tpu.server.metrics import render_engine_metrics
+
+    class _E:
+        lifecycle = LifecycleHistograms()
+
+        def stats(self):
+            return {
+                "num_requests_running": 0, "num_requests_waiting": 0,
+                "kv_cache_usage": 0.0, "prefix_cache_hits": 0,
+                "prefix_cache_queries": 0, "num_preemptions": 0,
+                "prompt_tokens_total": 0, "generation_tokens_total": 0,
+            }
+
+    e = _E()
+    e.lifecycle.queue_wait.observe(0.02)
+    e.lifecycle.prefill.observe(0.3)
+    e.lifecycle.decode_train.observe(0.05)
+    e.lifecycle.decode_train.observe(0.07)
+    e.lifecycle.restore_round_trip.observe(0.004)
+    text = render_engine_metrics(e, "m")
+    for name, count in (("pstpu:queue_wait_seconds", 1),
+                        ("pstpu:prefill_seconds", 1),
+                        ("pstpu:decode_train_seconds", 2),
+                        ("pstpu:restore_round_trip_seconds", 1)):
+        assert f'{name}_bucket{{model_name="m",le="+Inf"}} {count}' in text
+        assert f"{name}_count" in text
+        # cumulative monotonicity per series
+        counts = [
+            int(m.group(1)) for m in re.finditer(
+                name.replace(":", r"\:") + r'_bucket\{[^}]*\} (\d+)', text
+            )
+        ]
+        assert counts == sorted(counts) and counts[-1] == count
+    assert "pstpu:trace_spans_dropped_total" in text
+
+    # Collector surface: same four series through HistogramMetricFamily.
+    class _Cfg:
+        model_name = "m"
+        speculative_num_tokens = 0
+        role = "unified"
+        kv_cache_dtype = "bfloat16"
+
+    class _CE:
+        config = _Cfg()
+        scheduler = type("S", (), {"num_running": 0, "num_waiting": 0,
+                                   "num_preemptions_total": 0})()
+        block_manager = type(
+            "B", (), {"usage": lambda self: 0.0, "prefix_hits_total": 0,
+                      "prefix_queries_total": 0, "prefix_index_size": 0},
+        )()
+        prompt_tokens_total = 0
+        generation_tokens_total = 0
+        start_time = 0.0
+        offload_blocks_resident = 0
+        decode_dispatches_total = 0
+        prefill_dispatches_total = 0
+        fetches_total = 0
+        overlapped_fetches_total = 0
+        dispatch_gap_seconds_total = 0.0
+        resume_restored_tokens_total = 0
+        runner = None
+        disagg = None
+        offload = None
+        lifecycle = e.lifecycle
+
+        def _offload_stat(self, attr):
+            return 0
+
+    from production_stack_tpu.engine.metrics import EngineMetricsCollector
+
+    fams = {f.name: f for f in EngineMetricsCollector(_CE()).collect()}
+    # prometheus_client strips no suffix from histogram family names.
+    for name, count in (("pstpu:queue_wait_seconds", 1),
+                        ("pstpu:decode_train_seconds", 2)):
+        fam = fams[name]
+        samples = {s.name: s for s in fam.samples
+                   if s.name.endswith("_count")}
+        assert samples[f"{name}_count"].value == count
+    assert "pstpu:trace_spans_dropped" in fams
 
 
 def test_hpa_consumes_adapter_metric():

@@ -263,18 +263,24 @@ async def test_router_emits_trace_spans_with_propagation(monkeypatch):
         headers = {k.lower(): v for k, v in headers.items()}
         assert "traceparent" in headers
         # wait for the background exporter thread's periodic flush (its POST
-        # is served by the collector while this coroutine awaits)
+        # is served by the collector while this coroutine awaits). The
+        # fake engine traces its own span too, so wait for the ROUTER's
+        # span specifically — the engine's (inner, ends first) span can
+        # land in an earlier batch.
+        def _spans():
+            return [
+                sp
+                for b in batches
+                for rs in b["resourceSpans"]
+                for ss in rs["scopeSpans"]
+                for sp in ss["spans"]
+            ]
+
         for _ in range(100):
-            if batches:
+            if any(s["name"].startswith("router.route") for s in _spans()):
                 break
             await asyncio.sleep(0.1)
-        spans = [
-            sp
-            for b in batches
-            for rs in b["resourceSpans"]
-            for ss in rs["scopeSpans"]
-            for sp in ss["spans"]
-        ]
+        spans = _spans()
         assert any(s["name"].startswith("router.route") for s in spans)
         tp = headers["traceparent"]
         router_span = next(s for s in spans

@@ -19,9 +19,11 @@ from benchmarks.soak import (
     Fault,
     SLOClass,
     SoakViolation,
+    anomaly_reason,
     assert_soak_bars,
     build_report,
     class_summary,
+    collect_anomaly_records,
     parse_autoscaler_gauges,
     parse_classes,
     parse_fault_schedule,
@@ -274,6 +276,101 @@ def test_zero_5xx_bar_wiring():
                  "recovery_ok": False}],
     )
     assert_soak_bars(rep, max_recovery_s=60.0)
+
+
+def test_anomaly_collection_and_timeline_gate():
+    """Flight-record anomaly dumps (docs/OBSERVABILITY.md): SLO-miss /
+    error / truncation records land in the dump with their engine
+    timeline fetched by request id; the opt-in gate fails when an
+    SLO-missing request carries no timeline."""
+    met = _rec()                                  # meets SLO — no anomaly
+    miss = _rec(ttft=0.9)
+    miss.request_id = "req-miss"
+    err = _rec(status=500, gen=0)
+    trunc = _rec(status=599, gen=5)
+    trunc.truncated = True
+    assert anomaly_reason(met, SLO) is None
+    assert anomaly_reason(miss, SLO) == "slo_miss"
+    assert anomaly_reason(err, SLO) == "error"
+    assert anomaly_reason(trunc, SLO) == "truncated"
+
+    fetched = []
+
+    def fake_fetch(url, rid):
+        fetched.append((url, rid))
+        if url == "http://e2":
+            return {"request_id": rid, "records": [{"events": []}]}
+        return None                               # e1: 404 (wrong engine)
+
+    anomalies = collect_anomaly_records(
+        [met, miss, err, trunc], (SLO,), ["http://e1", "http://e2"],
+        fetch=fake_fetch,
+    )
+    assert [a["reason"] for a in anomalies] == [
+        "slo_miss", "error", "truncated",
+    ]
+    got_miss = anomalies[0]
+    # First engine 404'd; the second recognized the id.
+    assert fetched[:2] == [("http://e1", "req-miss"),
+                           ("http://e2", "req-miss")]
+    assert got_miss["engine"] == "http://e2"
+    assert got_miss["timeline"]["request_id"] == "req-miss"
+    # No request id captured -> no fetch attempted, timeline stays None.
+    assert anomalies[1]["timeline"] is None
+
+    # Report embeds the dump; the gate passes while the miss has a
+    # timeline and fails once it does not.
+    rep = _tiny_report(anomalies=anomalies)
+    assert rep["anomalies"][0]["timeline"] is not None
+    assert_soak_bars(rep, max_recovery_s=60.0,
+                     require_anomaly_timelines=True)
+    got_miss["timeline"] = None
+    rep = _tiny_report(anomalies=anomalies)
+    with pytest.raises(SoakViolation, match="no recorded flight"):
+        assert_soak_bars(rep, max_recovery_s=60.0,
+                         require_anomaly_timelines=True)
+    # Off by default: missing timelines don't fail historical runs.
+    assert_soak_bars(rep, max_recovery_s=60.0)
+    # Errors/truncations without timelines never trip the gate (their
+    # engine may have died with its ring).
+    only_err = [a for a in anomalies if a["reason"] != "slo_miss"]
+    rep = _tiny_report(anomalies=only_err)
+    assert_soak_bars(rep, max_recovery_s=60.0,
+                     require_anomaly_timelines=True)
+
+
+def test_anomaly_timeline_exemption_for_dead_engines():
+    """A record finished before the last engine-death fault completed is
+    marked timeline_expected: false (its recorder died with the engine)
+    and the gate does not fail on it; post-fault misses still must carry
+    a timeline."""
+    early = _rec(ttft=0.9, finish=10.0)
+    late = _rec(ttft=0.9, finish=50.0)
+    late.request_id = "req-late"
+    anomalies = collect_anomaly_records(
+        [early, late], (SLO,), ["http://e1"],
+        fetch=lambda u, r: None, engine_death_cutoff=20.0,
+    )
+    assert anomalies[0]["timeline_expected"] is False
+    assert anomalies[1]["timeline_expected"] is True
+    # Only the post-fault miss (timeline expected, none recorded) trips.
+    rep = _tiny_report(anomalies=[anomalies[0]])
+    assert_soak_bars(rep, max_recovery_s=60.0,
+                     require_anomaly_timelines=True)
+    rep = _tiny_report(anomalies=anomalies)
+    with pytest.raises(SoakViolation, match="no recorded flight"):
+        assert_soak_bars(rep, max_recovery_s=60.0,
+                         require_anomaly_timelines=True)
+
+
+def test_anomaly_cap_is_recorded_not_silent():
+    recs = [_rec(ttft=0.9) for _ in range(10)]
+    anomalies = collect_anomaly_records(
+        recs, (SLO,), [], max_anomalies=4, fetch=lambda u, r: None,
+    )
+    assert len(anomalies) == 5
+    assert anomalies[-1]["reason"] == "capped"
+    assert anomalies[-1]["skipped_anomalies"] == 6
 
 
 def test_metrics_text_parsers():

@@ -55,10 +55,70 @@ async def _serve(app):
 
 def test_parse_traceparent():
     tid, sid = "ab" * 16, "cd" * 8
-    assert parse_traceparent(f"00-{tid}-{sid}-01") == (tid, sid)
+    assert parse_traceparent(f"00-{tid}-{sid}-01") == (tid, sid, "01")
+    # The W3C trace-flags byte is parsed, not discarded: a not-sampled
+    # caller ("00") must stay not-sampled downstream.
+    assert parse_traceparent(f"00-{tid}-{sid}-00") == (tid, sid, "00")
     assert parse_traceparent(None) is None
     assert parse_traceparent("garbage") is None
     assert parse_traceparent(f"00-{tid}-short-01") is None
+    assert parse_traceparent(f"00-{'0' * 32}-{sid}-01") is None
+    # trace-flags must be EXACTLY two hex chars — a truncated field is a
+    # malformed header (fresh trace), never re-emitted downstream.
+    assert parse_traceparent(f"00-{tid}-{sid}-0") is None
+    assert parse_traceparent(f"00-{tid}-{sid}-012") is None
+
+
+def test_sampled_flag_propagates_not_hardcoded():
+    """A child span's traceparent carries the INCOMING trace-flags, not a
+    hardcoded '01' — an upstream not-sampled decision survives the hop."""
+    import queue
+
+    tid, sid = "ab" * 16, "cd" * 8
+    tracer = Tracer.__new__(Tracer)          # no exporter thread needed
+    tracer._queue = queue.Queue(maxsize=4)
+    tracer.spans_dropped_total = 0
+    tracer.on_drop = None
+    span = tracer.start_span("x", parent=f"00-{tid}-{sid}-00")
+    assert span.flags == "00"
+    assert span.traceparent == f"00-{tid}-{span.span_id}-00"
+    fresh = tracer.start_span("y", parent=None)
+    assert fresh.traceparent.endswith("-01")
+
+
+def test_queue_full_spans_are_counted_not_silent():
+    """end_span on a full queue increments spans_dropped_total and fires
+    the on_drop hook (the router's prometheus counter rides it)."""
+    import queue
+
+    tracer = Tracer.__new__(Tracer)
+    tracer._queue = queue.Queue(maxsize=1)
+    tracer.spans_dropped_total = 0
+    hits = []
+    tracer.on_drop = lambda: hits.append(1)
+    s1 = tracer.start_span("a")
+    s2 = tracer.start_span("b")
+    tracer.end_span(s1)
+    tracer.end_span(s2)      # queue full -> counted
+    assert tracer.spans_dropped_total == 1
+    assert hits == [1]
+
+
+def test_otlp_payload_carries_kind_and_events():
+    from production_stack_tpu.tracing import SPAN_KIND_CLIENT
+
+    tracer = Tracer.__new__(Tracer)
+    tracer.service_name = "svc"
+    span = tracer.start_span("router.route", kind=SPAN_KIND_CLIENT)
+    span.add_event("prestream_failure", {"backend": "http://e1",
+                                         "status": 503})
+    span.end_ns = span.start_ns + 1000
+    payload = tracer._otlp_payload([span])
+    otlp = payload["resourceSpans"][0]["scopeSpans"][0]["spans"][0]
+    assert otlp["kind"] == 3                  # CLIENT, not SERVER
+    assert otlp["events"][0]["name"] == "prestream_failure"
+    keys = {a["key"] for a in otlp["events"][0]["attributes"]}
+    assert {"backend", "status"} <= keys
 
 
 @pytest.mark.asyncio
@@ -101,6 +161,74 @@ def test_tracer_disabled_without_env(monkeypatch):
     reset_tracer()
     assert get_tracer() is None
     reset_tracer()
+
+
+async def _drain_spans(collector, want: int, seconds: float = 10.0):
+    for _ in range(int(seconds / 0.05)):
+        if len(collector.spans()) >= want:
+            return collector.spans()
+        await asyncio.sleep(0.05)
+    return collector.spans()
+
+
+@pytest.mark.asyncio
+async def test_router_to_engine_span_parentage_e2e(monkeypatch):
+    """The full proxy path against a stub OTLP collector: the router's
+    CLIENT-kind attempt span is the PARENT of the engine-side span under
+    ONE trace id (the W3C traceparent header actually propagated), and a
+    malformed client traceparent starts a FRESH trace instead of
+    poisoning the export batch."""
+    from tests.test_router_e2e import _start_stack, _stop_stack
+
+    collector = FakeCollector()
+    runner, base = await _serve(collector.app())
+    monkeypatch.setenv("OTEL_EXPORTER_OTLP_ENDPOINT", base)
+    monkeypatch.setenv("OTEL_SERVICE_NAME", "pstpu-e2e")
+    reset_tracer()
+    engines, servers, urls, client = await _start_stack(n_engines=1)
+    try:
+        resp = await client.post("/v1/completions", json={
+            "model": "m1", "prompt": "x", "max_tokens": 2,
+        })
+        assert resp.status == 200
+        await resp.read()
+        spans = await _drain_spans(collector, 2)
+        by_name = {s["name"]: s for _svc, s in spans}
+        rspan = by_name["router.route /v1/completions"]
+        espan = by_name["engine /v1/completions"]
+        # One trace, engine child of the router's outbound span.
+        assert espan["traceId"] == rspan["traceId"]
+        assert espan["parentSpanId"] == rspan["spanId"]
+        assert "parentSpanId" not in rspan
+        # The router's proxy hop is a CLIENT span; the engine serves.
+        assert rspan["kind"] == 3
+        assert espan["kind"] == 2
+
+        # Malformed traceparent -> fresh trace end-to-end (not the bogus
+        # id, no parent).
+        collector.batches.clear()
+        bogus = "00-" + "zz" * 16 + "-" + "cd" * 8 + "-01"
+        resp = await client.post("/v1/completions", json={
+            "model": "m1", "prompt": "x", "max_tokens": 2,
+        }, headers={"traceparent": bogus})
+        assert resp.status == 200
+        await resp.read()
+        spans = await _drain_spans(collector, 2)
+        by_name = {s["name"]: s for _svc, s in spans}
+        rspan = by_name["router.route /v1/completions"]
+        espan = by_name["engine /v1/completions"]
+        assert rspan["traceId"] != "zz" * 16
+        assert "parentSpanId" not in rspan
+        assert espan["parentSpanId"] == rspan["spanId"]
+    finally:
+        # Tear the tracer down while the collector loop is still free:
+        # the router's on_cleanup reset would otherwise drain-POST from
+        # inside the loop serving the collector.
+        monkeypatch.delenv("OTEL_EXPORTER_OTLP_ENDPOINT")
+        await asyncio.sleep(0.2)   # let the exporter thread flush its queue
+        reset_tracer()
+        await _stop_stack(servers, client)
+        await runner.cleanup()
 
 
 @pytest.mark.asyncio

@@ -38,6 +38,7 @@ TABLES = {
     "speculative": "docs/PERF.md",
     "multichip": "docs/PERF.md",
     "elastic": "docs/ELASTIC.md",
+    "lifecycle": "docs/OBSERVABILITY.md",
 }
 
 FLAG_TABLES = {

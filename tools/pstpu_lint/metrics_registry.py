@@ -191,6 +191,25 @@ REGISTRY: Tuple[Series, ...] = (
            _BOTH_ENGINE, ("catalogue", "elastic"),
            "Warmup variants that compiled from scratch (cold cache or "
            "changed config)"),
+    # ------------------------------------------ engine: request lifecycle
+    # (docs/OBSERVABILITY.md): per-phase latency split — where a request's
+    # TTFT went — plus tracing exporter hygiene.
+    Series("pstpu:queue_wait_seconds", "histogram", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "lifecycle"),
+           "Arrival to first dispatch issue per request (queue wait)"),
+    Series("pstpu:prefill_seconds", "histogram", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "lifecycle"),
+           "First prefill issue to final prefill chunk fetch per request"),
+    Series("pstpu:decode_train_seconds", "histogram", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "lifecycle"),
+           "Issue-to-fetch duration of each fused decode dispatch (train)"),
+    Series("pstpu:restore_round_trip_seconds", "histogram", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "lifecycle"),
+           "Duration of each shared-tier I/M restore round trip that "
+           "restored KV blocks"),
+    Series("pstpu:trace_spans_dropped_total", "counter", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "lifecycle"),
+           "OTLP spans dropped because the exporter queue was full"),
     # --------------------------------------------- engine: mid-stream resume
     Series("pstpu:resume_restored_tokens_total", "counter", ("model_name",),
            _BOTH_ENGINE, ("catalogue", "resume"),
@@ -291,6 +310,10 @@ REGISTRY: Tuple[Series, ...] = (
            "Client streams that ended without data: [DONE] (mid-stream "
            "failure not resumed, resume budget exhausted, or mid-stream "
            "deadline)",
+           router_labels=()),
+    Series("router_trace_spans_dropped_total", "counter", (), (ROUTER,),
+           ("catalogue", "lifecycle"),
+           "OTLP spans the router's exporter queue had to drop",
            router_labels=()),
     # ------------------------------------------------ router: autoscaling
     Series("router_queue_depth", "gauge", (), (ROUTER,),

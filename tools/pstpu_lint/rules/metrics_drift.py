@@ -138,10 +138,11 @@ def extract_engine_collector(engine_src: str) -> Extracted:
         if not isinstance(node, ast.Call):
             continue
         fn = node.func
-        if isinstance(fn, ast.Name) and fn.id in ("gauge", "counter"):
+        if isinstance(fn, ast.Name) and fn.id in ("gauge", "counter",
+                                                  "histogram"):
             name = _const_str(node.args[0]) if node.args else None
             if name:
-                kind = "gauge" if fn.id == "gauge" else "counter"
+                kind = fn.id
                 _add(name, kind, default_labels, node.lineno)
         elif isinstance(fn, ast.Name) and fn.id in (
             "GaugeMetricFamily", "CounterMetricFamily",
