@@ -281,6 +281,7 @@ def bench_stack(args) -> dict:
         routing_logic=args.routing_logic,
         router_args=router_args,
         num_engines=args.num_engines,
+        num_routers=max(1, getattr(args, "num_routers", 1) or 1),
         engine_env=engine_env,
         tensor_parallel_size=getattr(args, "tensor_parallel_size", 1),
     )
@@ -288,6 +289,9 @@ def bench_stack(args) -> dict:
     try:
         cfg = WorkloadConfig(
             base_url=stack.router_url,
+            base_urls=(list(stack.router_urls)
+                       if len(getattr(stack, "router_urls", []) or []) > 1
+                       else None),
             model=args.model,
             num_users=args.users,
             num_rounds=args.rounds,
@@ -565,6 +569,70 @@ def bench_multichip_sweep(args) -> dict:
     }
 
 
+def bench_router_sweep(args) -> dict:
+    """Router-tier QPS ceiling (docs/ROUTER_SCALE.md): bench_stack at
+    each replica count of --router-sweep over the SAME engine fleet and
+    workload, zero-5xx bar per point. The ``curve`` is what
+    tools/capacity.py --router-report folds into the chips->QPS model
+    (routers-per-QPS + the router_queue_depth HPA target)."""
+    points = [
+        int(x) for x in str(args.router_sweep).split(",") if x.strip()
+    ]
+    if not points:
+        raise ValueError("--router-sweep needs a replica list, e.g. 1,2")
+    _force_virtual_devices(args, args.tensor_parallel_size)
+    if args.backend == "cpu":
+        args.no_engine_warmup = True
+    runs = []
+    curve = []
+    base_qps = None
+    for n in points:
+        args.num_routers = n
+        res = bench_stack(args)
+        line = _result_line(args, res)
+        errors = line.get("errors_total", 0)
+        if errors:
+            raise RuntimeError(
+                f"router sweep point routers={n} leaked {errors} "
+                f"client-visible 5xx — a ceiling over a failing tier is "
+                f"not serving evidence"
+            )
+        qps = line.get("qps")
+        if base_qps is None:
+            base_qps = qps or 1.0
+        curve.append({
+            "routers": n,
+            "qps": qps,
+            "qps_per_router": round((qps or 0.0) / n, 4),
+            "qps_vs_one_router": round((qps or 0.0) / base_qps, 4),
+            "tok_s": line["value"],
+            "p50_ttft_s": line.get("p50_ttft_s"),
+            "avg_ttft_s": line.get("avg_ttft_s"),
+            "finished_requests": line.get("finished_requests"),
+            "errors_total": errors,
+        })
+        runs.append(line)
+        print(json.dumps({"router_sweep_point": curve[-1]}),
+              file=sys.stderr)
+    return {
+        "metric": f"router_tier_scaling_{args.model}",
+        "unit": "qps",
+        "backend": args.backend,
+        "model": args.model,
+        "num_engines": args.num_engines,
+        "workload": {
+            "users": args.users,
+            "rounds": args.rounds,
+            "history_tokens_per_user": args.history_tokens,
+            "max_tokens": args.max_tokens,
+        },
+        "curve": curve,
+        "zero_5xx": True,
+        "serving": True,
+        "runs": runs,
+    }
+
+
 # -------------------------------------------------------------- engine mode
 async def _run_session(engine, sampling, prompt, ttfts, prompt_toks=None):
     start = time.monotonic()
@@ -778,6 +846,21 @@ def main():
                     help="engine subprocesses behind the router; 2-process "
                          "smoke: --model facebook/opt-125m --num-engines 2 "
                          "--routing-logic cache_aware_load_balancing")
+    ap.add_argument("--num-routers", type=int, default=1,
+                    help="router replicas in front of the engine fleet "
+                         "(docs/ROUTER_SCALE.md): sessions spread "
+                         "round-robin, replicas share breaker gossip, "
+                         "and the soak's kill_router fault becomes "
+                         "available at >= 2")
+    ap.add_argument("--router-sweep", default=None,
+                    help="comma list of router replica counts (e.g. 1,2): "
+                         "run the stack bench once per point on the same "
+                         "engine fleet and print the router-tier scaling "
+                         "report (QPS ceiling per replica count, zero-5xx "
+                         "bar) — the ROUTER_SWEEP_r*.json artifact "
+                         "tools/capacity.py --router-report consumes")
+    ap.add_argument("--router-sweep-output", default=None,
+                    help="also write the --router-sweep report JSON here")
     ap.add_argument("--no-overlap", action="store_true",
                     help="A/B fallback: disable the two-slot prefill/"
                          "decode dispatch overlap")
@@ -918,6 +1001,16 @@ def main():
             require_zero_truncation=args.soak_require_zero_truncation,
             require_anomaly_timelines=args.soak_require_anomaly_timelines,
         )
+        return 0
+
+    if args.router_sweep:
+        args.mode = "stack"  # the router tier fronts a stack-shape run
+        report = bench_router_sweep(args)
+        print(json.dumps(report))
+        if args.router_sweep_output:
+            with open(args.router_sweep_output, "w") as f:
+                json.dump(report, f, indent=1)
+                f.write("\n")
         return 0
 
     if args.multichip_sweep:

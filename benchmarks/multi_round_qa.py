@@ -91,6 +91,15 @@ class WorkloadConfig:
     raise_on_error: bool = True
     # Label stamped on every record (soak per-class attribution).
     slo_class: str = ""
+    # Router replica tier (docs/ROUTER_SCALE.md): when set, sessions
+    # spread round-robin across these URLs (user_id % len) and a session
+    # whose router dies MID-STREAM reconnects to the next replica
+    # carrying x-pstpu-resume-tokens / x-pstpu-resume-seed (the pstpu
+    # payload it already received) — the peer splices a token-identical
+    # continuation, so a router SIGKILL is a failover, not a truncation.
+    # Pre-stream connect errors rotate replicas the same way.
+    base_urls: Optional[List[str]] = None
+    max_router_failovers: int = 3
 
 
 @dataclass
@@ -116,6 +125,8 @@ class RequestRecord:
     # uses to pull this request's flight-recorder timeline from the
     # engines (GET /debug/requests/{id}, docs/OBSERVABILITY.md).
     request_id: str = ""
+    # Cross-router reconnects this round survived (docs/ROUTER_SCALE.md).
+    router_failovers: int = 0
 
     @property
     def ok(self) -> bool:
@@ -167,6 +178,23 @@ class UserSession:
             seeded += 2 * turn_words
             turn += 1
         self.records: List[RequestRecord] = []
+        # Router replica rotation (docs/ROUTER_SCALE.md): each session is
+        # pinned to a replica round-robin; connect failures and mid-stream
+        # router deaths advance to the next one.
+        self._urls = list(cfg.base_urls) if cfg.base_urls \
+            else [cfg.base_url]
+        self._url_idx = user_id % len(self._urls)
+
+    def _base_url(self) -> str:
+        return self._urls[self._url_idx]
+
+    def _rotate_url(self) -> bool:
+        """Advance to the next replica; False when there is nowhere else
+        to go (single-URL workload)."""
+        if len(self._urls) <= 1:
+            return False
+        self._url_idx = (self._url_idx + 1) % len(self._urls)
+        return True
 
     def _question(self, rnd: int) -> str:
         cfg = self.cfg
@@ -210,10 +238,16 @@ class UserSession:
         sheds = 0
         truncated = False
         request_id = ""
+        # Delivered-token state for cross-router resume
+        # (docs/ROUTER_SCALE.md): the pstpu payload each chunk carries is
+        # exactly what a surviving replica needs to splice the tail.
+        toks: List[int] = []
+        seed: Optional[int] = None
+        failovers = 0
         while True:
             try:
                 async with http.post(
-                    f"{cfg.base_url}/v1/chat/completions", json=body,
+                    f"{self._base_url()}/v1/chat/completions", json=body,
                     headers=headers,
                 ) as resp:
                     status = resp.status
@@ -254,6 +288,21 @@ class UserSession:
                             prompt_tokens = usage.get("prompt_tokens", 0)
                             generation_tokens = usage.get(
                                 "completion_tokens", 0)
+                        meta = chunk.get("pstpu")
+                        if isinstance(meta, dict):
+                            if isinstance(meta.get("seed"), int) and \
+                                    not isinstance(meta["seed"], bool):
+                                seed = meta["seed"]
+                            ctoks = meta.get("toks") or []
+                            off = meta.get("off")
+                            if ctoks and isinstance(off, int):
+                                if off + len(ctoks) <= len(toks):
+                                    # Already delivered before a failover
+                                    # hop — drop, never repeat bytes.
+                                    continue
+                                toks.extend(
+                                    ctoks[max(0, len(toks) - off):]
+                                )
                         for choice in chunk.get("choices", []):
                             delta = (choice.get("delta") or {}).get("content")
                             if delta:
@@ -275,6 +324,23 @@ class UserSession:
             except aiohttp.ClientResponseError:
                 raise              # raise_on_error path (status preserved)
             except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+                if failovers < cfg.max_router_failovers \
+                        and (toks or first is None) \
+                        and self._rotate_url():
+                    # Router replica died (or refused the connect):
+                    # reconnect to the next replica. A stream that had
+                    # token state re-enters via the cross-router resume
+                    # headers so the peer splices the tail instead of
+                    # restarting the answer (docs/ROUTER_SCALE.md).
+                    failovers += 1
+                    if toks:
+                        headers["x-pstpu-resume-tokens"] = ",".join(
+                            str(t) for t in toks
+                        )
+                        if seed is not None:
+                            headers["x-pstpu-resume-seed"] = str(seed)
+                    status = 599
+                    continue
                 if status == 200:
                     # The 200 stream had begun; the transport died before
                     # [DONE] — a truncation, same as the clean-EOF case.
@@ -301,6 +367,7 @@ class UserSession:
             slo_class=cfg.slo_class,
             truncated=truncated,
             request_id=request_id,
+            router_failovers=failovers,
         ))
 
     async def run(self, http: aiohttp.ClientSession, start_delay: float,

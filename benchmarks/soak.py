@@ -45,9 +45,13 @@ SCHEMA = "pstpu-soak-v1"
 #: its time-to-first-SLO-met-token), scale-in drains one out with the
 #: zero-5xx bar still applying. Both require the stack to run a
 #: dynamic-config-backed router (bench.py --soak does).
+#: ``kill_router`` SIGKILLs router replica ``engine`` (index into the
+#: router tier, no drain, no relaunch) — the fault class the
+#: client-driven cross-router resume (docs/ROUTER_SCALE.md) must absorb;
+#: requires --num-routers >= 2 so a survivor can adopt the streams.
 FAULT_ACTIONS = (
     "restart_engine", "restart_kv_server", "degrade_engine", "heal_engine",
-    "kill_engine", "scale_out_engine", "scale_in_engine",
+    "kill_engine", "scale_out_engine", "scale_in_engine", "kill_router",
 )
 
 #: Router gauges the autoscaler wiring targets (docs/SOAK.md); the soak
@@ -319,6 +323,7 @@ def validate_report(report: dict) -> None:
 def build_report(*, model: str, backend: str, num_engines: int,
                  classes: Sequence[SLOClass], rungs: List[dict],
                  faults: List[dict], autoscaler_gauges: Dict[str, bool],
+                 num_routers: int = 1,
                  slo_attainment_gauge: Optional[Dict[str, float]] = None,
                  faults_scheduled: Optional[int] = None,
                  midstream_resumes: Optional[Dict[str, float]] = None,
@@ -350,6 +355,9 @@ def build_report(*, model: str, backend: str, num_engines: int,
         "model": model,
         "backend": backend,
         "num_engines": num_engines,
+        # Router-tier size (docs/ROUTER_SCALE.md); optional in the v1
+        # schema so earlier recorded artifacts still validate.
+        "num_routers": num_routers,
         "slo_classes": {
             c.name: {"ttft_slo_s": c.ttft_slo_s, "itl_slo_s": c.itl_slo_s,
                      "answer_tokens": c.answer_tokens, "share": c.share,
@@ -458,7 +466,9 @@ def assert_soak_bars(report: dict, max_recovery_s: float,
 def _rung_workloads(base_url: str, model: str,
                     classes: Sequence[SLOClass], qps: float,
                     duration_s: float, rung_idx: int,
-                    max_users_per_class: int = 64) -> Tuple[List, List[str]]:
+                    max_users_per_class: int = 64,
+                    base_urls: Optional[Sequence[str]] = None,
+                    ) -> Tuple[List, List[str]]:
     """WorkloadConfigs for one rung plus the classes whose session count
     hit ``max_users_per_class``. Each class launches sessions at its
     share of the rung QPS for the whole duration (the reference sweep
@@ -479,6 +489,7 @@ def _rung_workloads(base_url: str, model: str,
             capped.append(cls.name)
         cfgs.append(WorkloadConfig(
             base_url=base_url, model=model,
+            base_urls=list(base_urls) if base_urls else None,
             num_users=users, num_rounds=cls.rounds,
             system_prompt_words=60,
             question_words=cls.question_words,
@@ -537,11 +548,14 @@ async def run_ladder(base_url: str, model: str,
                      recovery_threshold: float = 0.9,
                      max_recovery_s: float = 120.0,
                      max_users_per_class: int = 64,
+                     base_urls: Optional[Sequence[str]] = None,
                      ) -> Tuple[List[dict], List[dict], list]:
     """Drive the QPS ladder with the chaos schedule running alongside.
     Returns (rung summaries, fault log, all records). Transport-agnostic:
     bench.py binds it to the subprocess stack, tests to an in-process
-    router over fake engines."""
+    router over fake engines. ``base_urls`` (router replica tier,
+    docs/ROUTER_SCALE.md) spreads sessions round-robin and arms the
+    client-side cross-router failover."""
     from benchmarks.multi_round_qa import run_workload
 
     t0 = time.monotonic()
@@ -558,7 +572,8 @@ async def run_ladder(base_url: str, model: str,
         for idx, qps in enumerate(ladder):
             cfgs, capped = _rung_workloads(base_url, model, classes, qps,
                                            rung_duration_s, idx,
-                                           max_users_per_class)
+                                           max_users_per_class,
+                                           base_urls=base_urls)
             if capped:
                 print(f"soak rung {idx} (qps {qps}): session count capped "
                       f"at {max_users_per_class} for {', '.join(capped)} — "
@@ -764,6 +779,27 @@ def parse_slo_attainment(metrics_text: str) -> Dict[str, float]:
             except ValueError:
                 continue
     return out
+
+
+def merged_router_metrics(texts: Sequence[str]) -> Tuple[
+        Dict[str, float], Dict[str, bool], Dict[str, float]]:
+    """Fold the SURVIVING router replicas' /metrics expositions into one
+    report view (docs/ROUTER_SCALE.md): resume/truncation counters SUM
+    across replicas (each replica only counts the streams it relayed),
+    autoscaler-gauge liveness ORs, and per-class SLO attainment takes the
+    WORST replica (conservative — the bar must hold on every replica).
+    Returns (midstream_resumes, autoscaler_gauges, slo_attainment)."""
+    resumes: Dict[str, float] = {}
+    gauges = dict.fromkeys(AUTOSCALER_GAUGES, False)
+    attain: Dict[str, float] = {}
+    for text in texts:
+        for k, v in parse_midstream_resumes(text).items():
+            resumes[k] = resumes.get(k, 0.0) + v
+        for k, v in parse_autoscaler_gauges(text).items():
+            gauges[k] = gauges[k] or v
+        for k, v in parse_slo_attainment(text).items():
+            attain[k] = min(attain[k], v) if k in attain else v
+    return resumes, gauges, attain
 
 
 def _await_running(engine_url: str, timeout_s: float) -> bool:
@@ -1035,6 +1071,24 @@ def make_stack_executor(stack, kv_handle=None,
             )
             info["downtime_s"] = round(downtime, 3)
             return info
+        if fault.action == "kill_router":
+            # SIGKILL a router replica, no drain, NO relaunch: every
+            # client stream relayed through it dies mid-byte and the
+            # CLIENT must reconnect to a surviving replica carrying its
+            # x-pstpu-resume-* state (docs/ROUTER_SCALE.md). The same
+            # "await_running" gate proves the kill lands mid-serving.
+            info = {}
+            wait_s = float(fault.params.get("await_running", 0) or 0)
+            if wait_s > 0:
+                info["was_serving"] = await asyncio.to_thread(
+                    _await_running, stack.engine_urls[0], wait_s
+                )
+            downtime = await asyncio.to_thread(
+                stack.kill_router, fault.engine
+            )
+            info["downtime_s"] = round(downtime, 3)
+            info["survivors"] = list(stack.live_router_urls)
+            return info
         if fault.action == "restart_kv_server":
             if kv_handle is None:
                 return {"skipped": True, "reason": "no kv server in stack"}
@@ -1169,6 +1223,10 @@ def _run_soak_once(args, prewarm_top_k: int, ramp_in_s: float) -> dict:
             routing_logic=getattr(args, "soak_routing_logic", "session"),
             router_args=router_args,
             num_engines=args.num_engines,
+            # Horizontally-scaled router tier (docs/ROUTER_SCALE.md):
+            # replicas share breaker gossip via --router-peer-dir and the
+            # workload spreads sessions across them round-robin.
+            num_routers=max(1, int(getattr(args, "num_routers", 1) or 1)),
             # Multi-chip soak (docs/PERF.md round 9): every engine on a
             # tp mesh — bench.py forces the virtual device platform on
             # CPU before this runs.
@@ -1195,6 +1253,7 @@ def _run_soak_once(args, prewarm_top_k: int, ramp_in_s: float) -> dict:
             )
             asyncio.run(run_workload(warm))
 
+        router_tier = list(stack.router_urls)
         ladder_t0 = time.monotonic()
         rungs, fault_log, _records = asyncio.run(run_ladder(
             stack.router_url, args.model, classes, ladder,
@@ -1204,9 +1263,18 @@ def _run_soak_once(args, prewarm_top_k: int, ramp_in_s: float) -> dict:
                 stack, kv_handle, classes=classes, elastic_log=elastic_log,
             ),
             max_recovery_s=args.soak_max_recovery,
+            base_urls=router_tier if len(router_tier) > 1 else None,
         ))
         _finish_elastic_windows(elastic_log)
-        metrics_text = _scrape_text(f"{stack.router_url}/metrics")
+        # Scrape every SURVIVING replica: a kill_router fault leaves its
+        # counters unreachable, but the peer that absorbed the resumes
+        # carries the outcome="peer" evidence.
+        metrics_texts = []
+        for rurl in stack.live_router_urls:
+            try:
+                metrics_texts.append(_scrape_text(f"{rurl}/metrics"))
+            except OSError:
+                continue
         # Flight-record dumps BEFORE teardown: the engines' recorders die
         # with their processes (docs/OBSERVABILITY.md anomaly dump).
         # Requests finished before the last engine-death fault completed
@@ -1236,13 +1304,15 @@ def _run_soak_once(args, prewarm_top_k: int, ramp_in_s: float) -> dict:
             except OSError:
                 pass
 
+    resumes, gauges, attain = merged_router_metrics(metrics_texts)
     return build_report(
         model=args.model, backend=args.backend,
-        num_engines=args.num_engines, classes=classes,
+        num_engines=args.num_engines,
+        num_routers=len(router_tier), classes=classes,
         rungs=rungs, faults=fault_log, faults_scheduled=len(faults),
-        autoscaler_gauges=parse_autoscaler_gauges(metrics_text),
-        slo_attainment_gauge=parse_slo_attainment(metrics_text),
-        midstream_resumes=parse_midstream_resumes(metrics_text),
+        autoscaler_gauges=gauges,
+        slo_attainment_gauge=attain,
+        midstream_resumes=resumes,
         elastic=elastic_log,
         anomalies=anomalies,
     )

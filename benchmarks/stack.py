@@ -139,9 +139,9 @@ def wait_health(url: str, timeout_s: float, proc: subprocess.Popen,
 @dataclass
 class StackHandle:
     engines: List[subprocess.Popen]
-    router: subprocess.Popen
+    routers: List[subprocess.Popen]
     engine_urls: List[str]
-    router_url: str
+    router_urls: List[str]
     log_paths: List[str] = field(default_factory=list)
     log_files: List[object] = field(default_factory=list)
     # Relaunch state (soak chaos: restart_engine): engine i's exact argv,
@@ -271,6 +271,47 @@ class StackHandle:
     def engine_url(self) -> str:
         return self.engine_urls[0]
 
+    @property
+    def router(self) -> subprocess.Popen:
+        """First LIVE router process (single-router callers / run*.sh)."""
+        for proc in self.routers:
+            if proc.poll() is None:
+                return proc
+        return self.routers[0]
+
+    @property
+    def router_url(self) -> str:
+        """URL of the first LIVE router replica. After kill_router the
+        facade moves to the next survivor, so single-URL callers keep
+        working through a router death (docs/ROUTER_SCALE.md)."""
+        for proc, url in zip(self.routers, self.router_urls):
+            if proc.poll() is None:
+                return url
+        raise RuntimeError("no live router replica")
+
+    @property
+    def live_router_urls(self) -> List[str]:
+        """All currently-live router replica URLs (metrics-merge scrapes)."""
+        return [url for proc, url in zip(self.routers, self.router_urls)
+                if proc.poll() is None]
+
+    def kill_router(self, index: int) -> float:
+        """HARD-kill router replica ``index``: SIGKILL, no drain, no
+        relaunch — in-flight client streams die mid-byte and the client
+        must reconnect to a surviving replica with its
+        x-pstpu-resume-* state (docs/ROUTER_SCALE.md). Returns seconds
+        spent waiting for the process to die."""
+        if len(self.live_router_urls) <= 1:
+            raise RuntimeError(
+                "refusing to kill the last live router replica"
+            )
+        proc = self.routers[index]
+        t0 = time.monotonic()
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=60)
+        return time.monotonic() - t0
+
     def _relaunch_engine(self, index: int, startup_timeout_s: float) -> None:
         """Relaunch engine ``index``'s exact argv/env on the same port and
         block until /health is 200 again."""
@@ -330,7 +371,7 @@ class StackHandle:
         return time.monotonic() - t0
 
     def terminate(self) -> None:
-        procs = [self.router, *self.engines]
+        procs = [*self.routers, *self.engines]
         for proc in procs:
             if proc.poll() is None:
                 proc.terminate()
@@ -355,6 +396,7 @@ def launch_stack(
     startup_timeout_s: float = 1800.0,
     log_dir: str = "/tmp",
     num_engines: int = 1,
+    num_routers: int = 1,
     per_engine_args: Optional[List[List[str]]] = None,
     engine_env: Optional[dict] = None,
     tensor_parallel_size: int = 1,
@@ -385,7 +427,14 @@ def launch_stack(
     scale_in mid-run; per-engine spawn->/health seconds land in
     StackHandle.engine_ready_seconds (healths are awaited sequentially,
     so later engines' values include queue wait — use a 1-engine stack
-    for a clean cold/warm boot A/B)."""
+    for a clean cold/warm boot A/B).
+
+    ``num_routers`` > 1 boots a horizontally-scaled router tier
+    (docs/ROUTER_SCALE.md): every replica sees the same backend set,
+    carries ``--router-id router-<i>``, and shares a
+    ``--router-peer-dir`` under ``log_dir`` for breaker gossip. Clients
+    spread across StackHandle.router_urls; StackHandle.kill_router is
+    the matching chaos fault."""
     if tensor_parallel_size > 1:
         pea = [list(a) for a in (per_engine_args or [])]
         while len(pea) < max(1, num_engines):
@@ -394,8 +443,9 @@ def launch_stack(
             ["--tensor-parallel-size", str(tensor_parallel_size), *a]
             for a in pea
         ]
-    router_port = free_port()
-    router_url = f"http://127.0.0.1:{router_port}"
+    num_routers = max(1, num_routers)
+    router_ports = [free_port() for _ in range(num_routers)]
+    router_urls = [f"http://127.0.0.1:{p}" for p in router_ports]
     served = served_model or model
 
     engines: List[subprocess.Popen] = []
@@ -457,27 +507,46 @@ def launch_stack(
                 "--dynamic-config-watch-interval",
                 str(dynamic_config_watch_interval),
             ]
-        router_cmd = [
-            sys.executable, "-m", "production_stack_tpu.router.app",
-            "--port", str(router_port),
-            "--service-discovery", "static",
-            "--static-backends", ",".join(engine_urls),
-            "--static-models", ",".join([served] * len(engine_urls)),
-            "--routing-logic", routing_logic,
-            *dyn_args,
-            *(router_args or []),
-        ]
-        rlog = os.path.join(log_dir, f"pstpu-bench-router-{router_port}.log")
-        rlog_f = open(rlog, "w")
-        log_paths.append(rlog)
-        log_files.append(rlog_f)
-        router = subprocess.Popen(
-            router_cmd, stdout=rlog_f, stderr=subprocess.STDOUT,
-        )
+        peer_args: List[str] = []
+        if num_routers > 1:
+            # Shared breaker-gossip directory for the replica tier. The
+            # gossip rides the dynamic-config watcher thread, so pin its
+            # interval even when no config file is watched.
+            peer_dir = os.path.join(
+                log_dir, f"pstpu-router-peers-{router_ports[0]}"
+            )
+            os.makedirs(peer_dir, exist_ok=True)
+            peer_args = ["--router-peer-dir", peer_dir]
+            if not dyn_args:
+                peer_args += ["--dynamic-config-watch-interval",
+                              str(dynamic_config_watch_interval)]
+        routers: List[subprocess.Popen] = []
+        for i, rport in enumerate(router_ports):
+            router_cmd = [
+                sys.executable, "-m", "production_stack_tpu.router.app",
+                "--port", str(rport),
+                "--service-discovery", "static",
+                "--static-backends", ",".join(engine_urls),
+                "--static-models", ",".join([served] * len(engine_urls)),
+                "--routing-logic", routing_logic,
+                "--router-id", f"router-{i}",
+                *peer_args,
+                *dyn_args,
+                *(router_args or []),
+            ]
+            rlog = os.path.join(log_dir, f"pstpu-bench-router-{rport}.log")
+            rlog_f = open(rlog, "w")
+            log_paths.append(rlog)
+            log_files.append(rlog_f)
+            routers.append(subprocess.Popen(
+                router_cmd, stdout=rlog_f, stderr=subprocess.STDOUT,
+            ))
         try:
-            wait_health(f"{router_url}/health", 120.0, router, "router")
+            for r, rurl in zip(routers, router_urls):
+                wait_health(f"{rurl}/health", 120.0, r, f"router {rurl}")
         except Exception:
-            router.kill()
+            for r in routers:
+                r.kill()
             raise
     except Exception:
         for engine in engines:
@@ -486,8 +555,8 @@ def launch_stack(
             f.close()
         raise
     return StackHandle(
-        engines=engines, router=router, engine_urls=engine_urls,
-        router_url=router_url, log_paths=log_paths, log_files=log_files,
+        engines=engines, routers=routers, engine_urls=engine_urls,
+        router_urls=router_urls, log_paths=log_paths, log_files=log_files,
         engine_cmds=engine_cmds, engine_log_files=engine_log_files,
         engine_env=dict(engine_env) if engine_env else None,
         engine_ready_seconds=engine_ready_seconds,

@@ -9,7 +9,8 @@ with the real CLI parsers). The subset — anything else is a template error:
   * paths: ``.Values.a.b``, ``$var.a.b``, ``.Release.Name/Namespace``,
     ``.Chart.Name``, ``.`` (current context)
   * ``if`` / ``else if`` / ``else`` / ``end`` with conditions: a path,
-    ``not <x>``, ``eq <a> <b>``, ``ne <a> <b>``, ``hasKey <map> "k"``
+    ``not <x>``, ``eq <a> <b>``, ``ne <a> <b>``, ``hasKey <map> "k"``,
+    ``gt``/``ge``/``lt``/``le`` (numeric, Go argument order)
   * ``range $var := <list>`` ... ``end`` (no implicit dot rebinding)
   * ``$var := <expr>`` assignment
   * ``include "name" <ctx>`` of ``define`` blocks (helpers)
@@ -377,6 +378,13 @@ _FUNCS = {
     "join": lambda r, f, ro, sep, v=None: sep.join(str(x) for x in (v or [])),
     "eq": lambda r, f, ro, a, b=None: a == b,
     "ne": lambda r, f, ro, a, b=None: a != b,
+    # Numeric comparisons (Go argument order: ``gt a b`` is a > b). Unset
+    # values compare as 0 so templates can gate on optional ints without
+    # a ``default`` wrapper (no parenthesized sub-expressions here).
+    "gt": lambda r, f, ro, a, b=None: _as_num(a) > _as_num(b),
+    "ge": lambda r, f, ro, a, b=None: _as_num(a) >= _as_num(b),
+    "lt": lambda r, f, ro, a, b=None: _as_num(a) < _as_num(b),
+    "le": lambda r, f, ro, a, b=None: _as_num(a) <= _as_num(b),
     "not": lambda r, f, ro, v=None: not _truthy(v),
     "hasKey": lambda r, f, ro, m, k=None: isinstance(m, dict) and k in m,
     "kindIs": lambda r, f, ro, kind, v=None: {
@@ -386,6 +394,17 @@ _FUNCS = {
         "float64": isinstance(v, float), "invalid": v is None,
     }.get(kind, False),
 }
+
+
+def _as_num(v: Any) -> float:
+    if v is None:
+        return 0.0
+    if isinstance(v, bool):
+        return float(v)
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        raise TemplateError(f"cannot compare non-numeric value {v!r}")
 
 
 def _deep_merge(base: Dict, over: Dict) -> Dict:

@@ -46,6 +46,7 @@ from production_stack_tpu.router.resilience import (
     get_resilience,
     get_slo_tracker,
     initialize_resilience,
+    set_router_id,
 )
 from production_stack_tpu.router.rewriter import get_request_rewriter
 from production_stack_tpu.router.routing_logic import (
@@ -370,8 +371,17 @@ def initialize_all(app: web.Application, args) -> None:
     initialize_engine_stats_scraper(
         args.engine_stats_interval,
         # The per-backend /prefix_index poll only pays for itself when the
-        # prefix-aware logic consumes it (docs/KV_ECONOMY.md).
-        scrape_prefix_index=(args.routing_logic == "prefix-aware"),
+        # prefix-aware logic consumes it (docs/KV_ECONOMY.md) — and with a
+        # shared KV tier configured, the ONE batched residency query per
+        # routing decision supersedes it entirely: with N router replicas
+        # the scrape would cost O(routers x engines) while the tier query
+        # stays O(1) per decision (docs/ROUTER_SCALE.md). Opt out
+        # explicitly with --no-prefix-index-scrape.
+        scrape_prefix_index=(
+            args.routing_logic == "prefix-aware"
+            and not getattr(args, "no_prefix_index_scrape", False)
+            and not getattr(args, "kv_offload_url", None)
+        ),
         on_new_backend=(_prewarm_new_backend if prewarm_top_k > 0 else None),
     )
     initialize_request_stats_monitor(args.request_stats_window)
@@ -392,6 +402,14 @@ def initialize_all(app: web.Application, args) -> None:
         # don't score load accept-and-ignore it.
         ramp_in_seconds=getattr(args, "ramp_in_seconds", 0.0),
         **routing_kwargs,
+    )
+    # Replica identity BEFORE the breaker registry exists, so every
+    # breaker's first publish already carries the router label.
+    import socket as _socket
+
+    set_router_id(
+        getattr(args, "router_id", None)
+        or f"{_socket.gethostname()}:{getattr(args, 'port', 0)}"
     )
     # getattr defaults keep pre-resilience arg namespaces (operator-rendered
     # configs, test fixtures) working.
@@ -464,12 +482,18 @@ def initialize_all(app: web.Application, args) -> None:
     app["rewriter"] = get_request_rewriter(args.request_rewriter)
     if args.callbacks:
         app["callbacks"] = initialize_custom_callbacks(args.callbacks)
-    if args.dynamic_config_json:
+    # Peer breaker gossip rides the same watcher thread, so the watcher
+    # also starts when only --router-peer-dir is set (config_path None).
+    if args.dynamic_config_json or getattr(args, "router_peer_dir", None):
+        from production_stack_tpu.router.resilience import get_router_id
+
         initialize_dynamic_config_watcher(
             args.dynamic_config_json,
             watch_interval=getattr(
                 args, "dynamic_config_watch_interval", 10.0
             ),
+            peer_dir=getattr(args, "router_peer_dir", None),
+            router_id=get_router_id(),
         )
 
 

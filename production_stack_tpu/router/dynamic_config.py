@@ -11,9 +11,10 @@ Contract parity with reference src/vllm_router/dynamic_config.py:
 
 import dataclasses
 import json
+import os
 import threading
 import time
-from typing import List, Optional
+from typing import Optional
 
 from production_stack_tpu.utils import (
     init_logger,
@@ -49,9 +50,22 @@ class DynamicRouterConfig:
 
 
 class DynamicConfigWatcher:
-    def __init__(self, config_path: str, watch_interval: float = 10.0):
+    """Polls the config file AND (when ``peer_dir`` is set) carries the
+    router tier's breaker-state gossip: each tick publishes this replica's
+    OPEN circuits to ``peer_dir/breakers-<router_id>.json`` and adopts
+    peers' OPEN circuits (docs/ROUTER_SCALE.md). One watch interval is thus
+    the worst-case time for replica B to learn a backend replica A already
+    ejected — local observations still take effect immediately.
+    ``config_path`` may be None when only the peer plane is wanted."""
+
+    def __init__(self, config_path: Optional[str],
+                 watch_interval: float = 10.0,
+                 peer_dir: Optional[str] = None,
+                 router_id: Optional[str] = None):
         self.config_path = config_path
         self.watch_interval = watch_interval
+        self.peer_dir = peer_dir
+        self.router_id = router_id or "router"
         self.current_config: Optional[DynamicRouterConfig] = None
         self._running = True
         self._thread = threading.Thread(
@@ -61,19 +75,59 @@ class DynamicConfigWatcher:
 
     def _watch_worker(self) -> None:
         while self._running:
+            if self.config_path:
+                try:
+                    config = DynamicRouterConfig.from_json(self.config_path)
+                    if self.current_config is None or \
+                            config != self.current_config:
+                        logger.info("Dynamic config changed; applying %s",
+                                    config.to_dict())
+                        self._apply(config)
+                        self.current_config = config
+                except FileNotFoundError:
+                    pass
+                except Exception:  # noqa: BLE001 — watcher survives bad JSON
+                    logger.exception("Failed to load dynamic config")
             try:
-                config = DynamicRouterConfig.from_json(self.config_path)
-                if self.current_config is None or \
-                        config != self.current_config:
-                    logger.info("Dynamic config changed; applying %s",
-                                config.to_dict())
-                    self._apply(config)
-                    self.current_config = config
-            except FileNotFoundError:
-                pass
-            except Exception:  # noqa: BLE001 — watcher must survive bad JSON
-                logger.exception("Failed to load dynamic config")
+                self.sync_peer_state()
+            except Exception:  # noqa: BLE001 — gossip is best-effort
+                logger.exception("Failed to sync peer breaker state")
             time.sleep(self.watch_interval)
+
+    def sync_peer_state(self) -> None:
+        """One publish+reconcile pass of the breaker gossip (public so
+        tests can drive a deterministic tick)."""
+        if not self.peer_dir:
+            return
+        from production_stack_tpu.router.resilience import get_resilience
+
+        manager = get_resilience()
+        if manager is None:
+            return
+        os.makedirs(self.peer_dir, exist_ok=True)
+        mine = f"breakers-{self.router_id}.json"
+        # Remaining-seconds deltas, not timestamps: monotonic clocks don't
+        # transfer between processes and wall clocks skew. Staleness is
+        # bounded by the watch interval; apply_remote_open clamps the rest.
+        payload = {"router_id": self.router_id,
+                   "open": manager.peer_snapshot()}
+        tmp = os.path.join(self.peer_dir, mine + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, os.path.join(self.peer_dir, mine))
+        for name in sorted(os.listdir(self.peer_dir)):
+            if name == mine or not name.startswith("breakers-") \
+                    or not name.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(self.peer_dir, name)) as f:
+                    peer = json.load(f)
+                manager.apply_peer_state(
+                    str(peer.get("router_id") or name),
+                    peer.get("open") or {},
+                )
+            except (OSError, ValueError):
+                continue   # partially-written / vanished peer file
 
     def _apply(self, config: DynamicRouterConfig) -> None:
         from production_stack_tpu.router.routing_logic import (
@@ -119,12 +173,14 @@ _watcher: Optional[DynamicConfigWatcher] = None
 
 
 def initialize_dynamic_config_watcher(
-    config_path: str, watch_interval: float = 10.0
+    config_path: Optional[str], watch_interval: float = 10.0,
+    peer_dir: Optional[str] = None, router_id: Optional[str] = None,
 ) -> DynamicConfigWatcher:
     global _watcher
     if _watcher is not None:
         _watcher.close()
-    _watcher = DynamicConfigWatcher(config_path, watch_interval)
+    _watcher = DynamicConfigWatcher(config_path, watch_interval,
+                                    peer_dir=peer_dir, router_id=router_id)
     return _watcher
 
 

@@ -86,8 +86,9 @@ router_failovers_total = Counter(
 )
 router_circuit_state = Gauge(
     "router_circuit_state",
-    "Circuit breaker state per backend (0=closed, 1=open, 2=half-open)",
-    ["server"],
+    "Circuit breaker state per backend (0=closed, 1=open, 2=half-open); "
+    "router identifies the observing replica (docs/ROUTER_SCALE.md)",
+    ["server", "router"],
 )
 router_deadline_exceeded_total = Counter(
     "router_deadline_exceeded",
@@ -101,7 +102,8 @@ router_midstream_resumes_total = Counter(
     "router_midstream_resumes",
     "Mid-stream backend failures the router tried to resume on another "
     "backend (outcome: resumed = continuation spliced, failed = no backend "
-    "could attach)",
+    "could attach, peer = client reconnected to this replica with "
+    "x-pstpu-resume-* state after losing another router mid-stream)",
     ["outcome"],
 )
 router_truncations_total = Counter(

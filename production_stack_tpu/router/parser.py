@@ -80,6 +80,26 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "hottest prefix chains before taking load "
                         "(docs/ELASTIC.md; 0 disables; engines without a "
                         "shared tier no-op the request)")
+    p.add_argument("--router-id", default=None,
+                   help="identity of this router replica in logs, the "
+                        "router label on router_circuit_state, and peer "
+                        "breaker-state files (docs/ROUTER_SCALE.md); "
+                        "defaults to hostname:port. Helm wires the pod "
+                        "name when routerSpec.replicas > 1")
+    p.add_argument("--router-peer-dir", default=None,
+                   help="shared directory where router replicas publish "
+                        "and reconcile breaker state through the "
+                        "dynamic-config watch plane (one JSON file per "
+                        "replica; a peer's OPEN circuit is adopted within "
+                        "one --dynamic-config-watch-interval). Unset "
+                        "disables peer reconciliation")
+    p.add_argument("--no-prefix-index-scrape", action="store_true",
+                   help="skip the per-engine /prefix_index scrape pass "
+                        "(prefix-aware routing then relies on the shared "
+                        "KV tier's batched index query + session rungs); "
+                        "implied when --kv-offload-url is set with "
+                        "prefix-aware routing, where the shared-tier path "
+                        "supersedes O(routers x engines) scrape traffic")
     p.add_argument("--engine-stats-interval", type=float, default=10.0,
                    help="seconds between engine /metrics scrape passes "
                         "(newly discovered backends are additionally "

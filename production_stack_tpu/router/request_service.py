@@ -176,6 +176,12 @@ async def route_general_request(
         rewriter = app.get("rewriter")
         if rewriter is not None:
             body = rewriter.rewrite(body, endpoint)
+        # Cross-router resume (docs/ROUTER_SCALE.md): only on the direct
+        # client entry — internal hops (disagg decode, unified fallback)
+        # hand a processed body via body_override and must not re-inject.
+        bad = _apply_client_resume(request.headers, body, endpoint)
+        if bad is not None:
+            return bad
 
     if pool is not None:
         endpoints = list(pool)
@@ -298,6 +304,61 @@ async def route_general_request(
              f"{last_failure}",
         etype="bad_gateway",
     )
+
+
+# Cross-router stream resume headers (docs/ROUTER_SCALE.md): a client that
+# lost its router mid-stream reconnects to ANY peer replica carrying the
+# {toks, off, seed} state it already received via the pstpu chunk payloads.
+RESUME_TOKENS_HEADER = "x-pstpu-resume-tokens"
+RESUME_SEED_HEADER = "x-pstpu-resume-seed"
+
+
+def _apply_client_resume(headers, body, endpoint: str):
+    """Fold the client's cross-router resume headers into the request body.
+
+    The peer replica then re-enters the ordinary PR-9 resume machinery as
+    if the interrupted relay had been its own: ``resume_tokens`` seeds the
+    SseResumeParser (overlap re-emission deduped by token offset), the
+    engine restores the prompt+delivered chain and continues
+    token-identically (greedy and seeded), and the prefix-aware policy
+    scores the full delivered chain. No router-to-router state transfer:
+    the client IS the state channel. Returns an error response for
+    malformed/ineligible resume requests, else None (body mutated)."""
+    raw = headers.get(RESUME_TOKENS_HEADER)
+    if raw is None:
+        return None
+    if not _resume_eligible(body, endpoint):
+        return _error(
+            400, f"{RESUME_TOKENS_HEADER} requires a single-choice "
+                 f"streaming generation request (stream=true, n=1, no "
+                 f"tools/logprobs)",
+        )
+    try:
+        toks = [int(t) for t in raw.split(",") if t.strip()]
+    except ValueError:
+        return _error(
+            400, f"{RESUME_TOKENS_HEADER} must be comma-separated token ids",
+        )
+    if not toks:
+        return _error(
+            400, f"{RESUME_TOKENS_HEADER} carried no token ids; reconnect "
+                 f"without resume headers to restart the generation",
+        )
+    seed_raw = headers.get(RESUME_SEED_HEADER)
+    if seed_raw is not None:
+        try:
+            body["resume_seed"] = int(seed_raw)
+        except ValueError:
+            return _error(
+                400, f"{RESUME_SEED_HEADER} must be an integer seed",
+            )
+    body["resume_tokens"] = toks
+    metrics.router_midstream_resumes_total.labels(outcome="peer").inc()
+    logger.info(
+        "Client-driven cross-router resume: %d delivered token(s), "
+        "seed %s", len(toks), seed_raw,
+    )
+    return None
 
 
 def _resume_eligible(body, endpoint: str) -> bool:
@@ -1251,6 +1312,12 @@ async def route_disagg_request(
             body_override=body, deadline=deadline,
         )
 
+    if RESUME_TOKENS_HEADER in request.headers:
+        # Cross-router resume: the delivered chain's KV lives on the engine
+        # (or the shared tier) already — a fresh prefill hop would waste it
+        # and the handoff manifest can't represent a mid-generation splice.
+        # The unified path owns resume (policy hooks run there, once).
+        return await route_general_request(request, endpoint)
     try:
         body_bytes = request.get("pii_redacted_body") or await request.read()
         body = json.loads(body_bytes) if body_bytes else {}

@@ -37,6 +37,22 @@ logger = init_logger(__name__)
 CLOSED, OPEN, HALF_OPEN = 0, 1, 2
 _STATE_NAMES = {CLOSED: "closed", OPEN: "open", HALF_OPEN: "half_open"}
 
+# Identity of THIS router replica (docs/ROUTER_SCALE.md): shows up in
+# breaker transition logs, the ``router`` label on router_circuit_state,
+# and the peer breaker-state files — so a 2-replica Grafana view can tell
+# WHICH replica tripped. Set once at startup from --router-id.
+_router_id = "router"
+
+
+def set_router_id(router_id: str) -> None:
+    global _router_id
+    if router_id:
+        _router_id = router_id
+
+
+def get_router_id() -> str:
+    return _router_id
+
 #: Backend HTTP statuses treated as a pre-stream backend failure (the pod
 #: is restarting / shedding); anything else is relayed to the client as-is.
 RETRYABLE_STATUSES = (502, 503)
@@ -180,7 +196,9 @@ class CircuitBreaker:
         self._publish()
 
     def _publish(self) -> None:
-        metrics.router_circuit_state.labels(server=self.url).set(self.state)
+        metrics.router_circuit_state.labels(
+            server=self.url, router=get_router_id()
+        ).set(self.state)
 
     def _trim(self, now: float) -> None:
         cutoff = now - self.cfg.breaker_window
@@ -202,7 +220,8 @@ class CircuitBreaker:
             self._probe_at = 0.0
             self._half_open_since = now
             self._publish()
-            logger.info("Circuit %s: open -> half-open (probing)", self.url)
+            logger.info("[%s] Circuit %s: open -> half-open (probing)",
+                        get_router_id(), self.url)
         # HALF_OPEN: one probe at a time. The probe slot is a LEASE, not a
         # flag — if the probe's outcome is never reported (e.g. the request
         # hit its deadline), the slot frees itself after open_duration.
@@ -224,8 +243,8 @@ class CircuitBreaker:
                 # next probe dispatches without waiting out open_duration.
                 self._probe_at = 0.0
                 logger.info(
-                    "Circuit %s: half-open probe ok, dwelling "
-                    "(%.2fs of %.2fs)", self.url,
+                    "[%s] Circuit %s: half-open probe ok, dwelling "
+                    "(%.2fs of %.2fs)", get_router_id(), self.url,
                     now - self._half_open_since,
                     self.cfg.breaker_half_open_dwell,
                 )
@@ -234,10 +253,32 @@ class CircuitBreaker:
             self._outcomes = []
             self._probe_at = 0.0
             self._publish()
-            logger.info("Circuit %s: half-open -> closed (probe ok)", self.url)
+            logger.info("[%s] Circuit %s: half-open -> closed (probe ok)",
+                        get_router_id(), self.url)
             return
         self._outcomes.append((now, True))
         self._trim(now)
+
+    def apply_remote_open(self, remaining_s: float, peer: str) -> None:
+        """Adopt a PEER replica's OPEN verdict on this backend
+        (docs/ROUTER_SCALE.md). One-way and advisory: only a locally-CLOSED
+        breaker opens — a breaker that is already OPEN (local evidence) or
+        HALF_OPEN (actively probing; the probe result is strictly fresher
+        than the peer's snapshot) is never touched, and a peer can never
+        CLOSE a circuit here. The open is backdated so the half-open probe
+        fires when the peer's cooldown would, not a full window later."""
+        if self.state != CLOSED or remaining_s <= 0:
+            return
+        remaining_s = min(remaining_s, self.cfg.breaker_open_duration)
+        self.state = OPEN
+        self._opened_at = time.monotonic() - (
+            self.cfg.breaker_open_duration - remaining_s
+        )
+        self._publish()
+        logger.warning(
+            "[%s] Circuit %s: closed -> open (adopted from peer %s, "
+            "%.1fs remaining)", get_router_id(), self.url, peer, remaining_s,
+        )
 
     def record_failure(self) -> None:
         now = time.monotonic()
@@ -246,8 +287,8 @@ class CircuitBreaker:
             self._opened_at = now
             self._probe_at = 0.0
             self._publish()
-            logger.warning("Circuit %s: half-open -> open (probe failed)",
-                           self.url)
+            logger.warning("[%s] Circuit %s: half-open -> open (probe failed)",
+                           get_router_id(), self.url)
             return
         self._outcomes.append((now, False))
         self._trim(now)
@@ -262,8 +303,9 @@ class CircuitBreaker:
             self._opened_at = now
             self._publish()
             logger.warning(
-                "Circuit %s: closed -> open (%d/%d failures in %.0fs window)",
-                self.url, failures, total, self.cfg.breaker_window,
+                "[%s] Circuit %s: closed -> open (%d/%d failures in %.0fs "
+                "window)", get_router_id(), self.url, failures, total,
+                self.cfg.breaker_window,
             )
 
 
@@ -301,6 +343,35 @@ class ResilienceManager:
             url: _STATE_NAMES[br.state]
             for url, br in sorted(self._breakers.items())
         }
+
+    # ------------------------------------------------ peer reconciliation
+    def peer_snapshot(self) -> Dict[str, float]:
+        """url -> remaining open seconds, for every currently-OPEN circuit.
+        The only breaker state worth telling peer replicas about
+        (docs/ROUTER_SCALE.md): remaining-time deltas transfer across
+        processes where monotonic timestamps cannot."""
+        now = time.monotonic()
+        out = {}
+        for url, br in self._breakers.items():
+            if br.state != OPEN:
+                continue
+            rem = self.config.breaker_open_duration - (now - br._opened_at)
+            if rem > 0:
+                out[url] = round(rem, 3)
+        return out
+
+    def apply_peer_state(self, peer_id: str,
+                         open_circuits: Dict[str, float]) -> None:
+        """Adopt a peer replica's OPEN circuits (published through the
+        dynamic-config watch plane). Malformed entries are skipped — peer
+        files are best-effort, never load-bearing for correctness."""
+        for url, rem in (open_circuits or {}).items():
+            try:
+                self._breaker(str(url)).apply_remote_open(
+                    float(rem), peer_id
+                )
+            except (TypeError, ValueError):
+                continue
 
 
 class SLOTracker:

@@ -20,6 +20,7 @@ import time
 from collections import OrderedDict
 from typing import Dict, List, Optional
 
+from production_stack_tpu.router.ring import PlacementRing, near_least_loaded
 from production_stack_tpu.router.service_discovery import EndpointInfo
 from production_stack_tpu.router.stats.engine_stats import EngineStats
 from production_stack_tpu.router.stats.request_stats import RequestStats
@@ -27,6 +28,29 @@ from production_stack_tpu.utils import SingletonABCMeta, init_logger
 from production_stack_tpu.utils.hashring import HashRing
 
 logger = init_logger(__name__)
+
+# Predicted hit rate for the ring's session->engine pick when THIS replica
+# has no local affinity entry. With N router replicas, "no local entry"
+# usually means a peer replica served the session — and since every replica
+# computes the same ring, the ring pick IS where the peer sent it. 0.7
+# (not 1.0): the ring can't see evictions or timeouts the local map would.
+RING_AFFINITY_PRIOR = 0.7
+
+
+def _near_least_loaded_urls(endpoints, engine_stats, request_stats,
+                            ramp_in_seconds: float) -> List[str]:
+    """URLs within ring.LOAD_MARGIN of the least-loaded endpoint — the
+    candidate set the placement ring deterministically picks among. When
+    one engine is clearly least loaded this collapses to exactly it
+    (pre-ring behavior); comparably-loaded engines defer to the ring so
+    every replica agrees."""
+    by_url = {ep.url: ep for ep in endpoints}
+    return near_least_loaded(
+        by_url,
+        lambda u: CacheAwareLoadBalancingRouter._engine_load_score(
+            u, engine_stats, request_stats
+        ) + ramp_in_penalty(by_url[u], ramp_in_seconds),
+    )
 
 
 class RoutingLogic:
@@ -184,8 +208,11 @@ class CacheAwareLoadBalancingRouter(RoutingInterface):
         self.cache_weight = cache_weight
         self.load_weight = load_weight
         self.ramp_in_seconds = ramp_in_seconds
-        # session -> (engine_url, last_seen_ts)
+        # session -> (engine_url, last_seen_ts). Replica-local HINT only:
+        # the cross-replica source of truth for first-contact placement is
+        # the deterministic ring below (docs/ROUTER_SCALE.md).
         self._affinity = LRUCache(capacity=8192)
+        self._ring = PlacementRing()
         self._rr = 0
 
     # ------------------------------------------------------------- components
@@ -233,9 +260,24 @@ class CacheAwareLoadBalancingRouter(RoutingInterface):
         if headers is not None and self.session_key:
             session_id = headers.get(self.session_key)
 
+        # No fresh LOCAL affinity for this session: a peer replica may
+        # still hold its KV-warm engine. The ring computes that engine
+        # deterministically from membership alone, so credit the ring pick
+        # with a reuse prior instead of treating the session as cold.
+        self._ring.sync(ep.url for ep in endpoints)
+        ring_url = None
+        if session_id is not None:
+            entry = self._affinity.get(session_id)
+            fresh = entry is not None and \
+                time.time() - entry[1] < self.block_reuse_timeout
+            if not fresh:
+                ring_url = self._ring.pick_session(str(session_id))
+
         best_url, best_score = None, float("-inf")
         for ep in sorted(endpoints, key=lambda e: e.url):
             hit = self._predict_cache_hit_rate(session_id, ep.url, engine_stats)
+            if hit == 0.0 and ep.url == ring_url:
+                hit = RING_AFFINITY_PRIOR
             load = self._engine_load_score(ep.url, engine_stats, request_stats)
             load += ramp_in_penalty(ep, self.ramp_in_seconds)
             score = self.cache_weight * hit - self.load_weight * load
@@ -315,7 +357,9 @@ class PrefixAwareRouter(RoutingInterface):
         self._kv_url = kv_offload_url
         self._kv_down_until = 0.0
         # session -> (engine_url, last_seen_ts) — the final fallback rung.
+        # Replica-local hint; cross-replica agreement comes from the ring.
         self._affinity = LRUCache(capacity=8192)
+        self._ring = PlacementRing()
         self._rr = 0
         # decision telemetry (surfaced through /health-style debugging and
         # unit tests; Prometheus export stays on the scrape plane)
@@ -536,6 +580,7 @@ class PrefixAwareRouter(RoutingInterface):
         if headers is not None and self.session_key:
             session_id = headers.get(self.session_key)
 
+        self._ring.sync(ep.url for ep in endpoints)
         token_ids = self._prompt_token_ids(request)
         index = self._index() if token_ids else {}
         hash_cache: dict = {}
@@ -586,14 +631,26 @@ class PrefixAwareRouter(RoutingInterface):
             hashes = hash_cache.get(bs) or self._prefix_hashes(token_ids, bs)
             if self.tier_restorable_blocks(hashes) > 0:
                 self.routed_by_tier += 1
-                url = self._least_loaded(
-                    endpoints, engine_stats, request_stats
+                # Any engine can restore; deterministic ring pick (keyed by
+                # the prefix chain head) among near-least-loaded engines, so
+                # N replicas funnel the SAME tier-restorable prefix to the
+                # SAME engine and its device cache warms once, not N times.
+                cands = _near_least_loaded_urls(
+                    endpoints, engine_stats, request_stats,
+                    self.ramp_in_seconds,
                 )
+                url = self._ring.pick_prefix(
+                    hashes[0].hex()[:16], cands
+                ) or self._least_loaded(endpoints, engine_stats,
+                                        request_stats)
                 if session_id is not None:
                     self._affinity.put(session_id, (url, time.time()))
                 return url
 
-        # Final rung: the existing session-affinity logic.
+        # Final rung: session placement. Fresh LOCAL affinity wins (it saw
+        # the actual pick); otherwise the deterministic ring decides among
+        # near-least-loaded engines — the replica-agnostic replacement for
+        # "least loaded with replica-local tie-breaking".
         self.routed_by_fallback += 1
         if session_id is not None:
             entry = self._affinity.get(session_id)
@@ -603,7 +660,14 @@ class PrefixAwareRouter(RoutingInterface):
                     if ep.url == entry[0]:
                         self._affinity.put(session_id, (ep.url, time.time()))
                         return ep.url
-        url = self._least_loaded(endpoints, engine_stats, request_stats)
+        url = None
+        if session_id is not None:
+            cands = _near_least_loaded_urls(
+                endpoints, engine_stats, request_stats, self.ramp_in_seconds
+            )
+            url = self._ring.pick_session(str(session_id), cands)
+        if url is None:
+            url = self._least_loaded(endpoints, engine_stats, request_stats)
         if session_id is not None:
             self._affinity.put(session_id, (url, time.time()))
         return url
@@ -650,8 +714,10 @@ class DisaggRouter(RoutingInterface):
         self.session_key = session_key
         self.block_reuse_timeout = block_reuse_timeout
         self.ramp_in_seconds = ramp_in_seconds
-        # session -> (decode_engine_url, last_seen_ts)
+        # session -> (decode_engine_url, last_seen_ts); replica-local hint,
+        # ring below is the cross-replica tie-breaker.
         self._affinity = LRUCache(capacity=8192)
+        self._ring = PlacementRing()
         self._rr = 0
 
     # ----------------------------------------------------------------- pools
@@ -706,7 +772,19 @@ class DisaggRouter(RoutingInterface):
                     if ep.url == entry[0]:
                         self._affinity.put(session_id, (ep.url, time.time()))
                         return ep.url
-        url = self._least_loaded(endpoints, engine_stats, request_stats)
+        url = None
+        if session_id is not None:
+            # Deterministic decode placement among near-least-loaded decode
+            # engines: any replica handling this session's next hop lands
+            # on the same KV-warm engine without a state exchange.
+            self._ring.sync(ep.url for ep in endpoints)
+            url = self._ring.pick_session(
+                str(session_id),
+                _near_least_loaded_urls(endpoints, engine_stats,
+                                        request_stats, self.ramp_in_seconds),
+            )
+        if url is None:
+            url = self._least_loaded(endpoints, engine_stats, request_stats)
         if session_id is not None:
             self._affinity.put(session_id, (url, time.time()))
         return url

@@ -65,5 +65,26 @@ class HashRing:
             idx = 0
         return self._ring[self._sorted_keys[idx]]
 
+    def get_node_among(self, key: str,
+                       allowed: Iterable[str]) -> Optional[str]:
+        """First ring successor of ``key`` that is in ``allowed``.
+
+        Restricting the walk (instead of building a throwaway sub-ring)
+        keeps the full ring's key->node geometry: a key whose successor IS
+        allowed maps exactly as ``get_node`` would, and excluding a node
+        moves only the keys that would have landed on it — the same
+        bounded-churn property membership changes have."""
+        allowed = set(allowed)
+        if not self._sorted_keys or not allowed:
+            return None
+        h = _hash(key)
+        start = bisect.bisect(self._sorted_keys, h)
+        n = len(self._sorted_keys)
+        for step in range(n):
+            node = self._ring[self._sorted_keys[(start + step) % n]]
+            if node in allowed:
+                return node
+        return None
+
     def __len__(self) -> int:
         return len(self._nodes)

@@ -424,3 +424,49 @@ def test_elastic_values_render_engine_and_router_flags():
     with open(os.path.join(CHART, "values.schema.json")) as f:
         schema = json.load(f)
     jsonschema.validate(values, schema)
+
+
+def test_router_replicas_wire_pod_name_router_id():
+    """routerSpec.replicas > 1 scales the router Deployment AND wires each
+    replica's --router-id from its pod name via the Downward API
+    (docs/ROUTER_SCALE.md); at 1 replica the identity plumbing stays off
+    and replicaCount remains authoritative."""
+    values = {"routerSpec": {"replicas": 3}}
+    manifests = render_chart(CHART, values=values, release_name="stack")
+    router = next(
+        m for m in _by_kind(manifests, "Deployment")
+        if m["metadata"]["name"].endswith("deployment-router")
+    )
+    assert router["spec"]["replicas"] == 3
+    c = _container(router, "router")
+    env = {e["name"]: e for e in c.get("env") or []}
+    assert env["POD_NAME"]["valueFrom"]["fieldRef"]["fieldPath"] == \
+        "metadata.name"
+    args = [str(a) for a in c["args"]]
+    assert args[args.index("--router-id") + 1] == "$(POD_NAME)"
+    # The rendered args still parse with the real router CLI parser
+    # (kubelet substitutes $(POD_NAME) before exec; any string parses).
+    from production_stack_tpu.router.parser import (
+        parse_args as router_parse_args,
+    )
+
+    ns = router_parse_args(args)
+    assert ns.router_id == "$(POD_NAME)"
+    # The knob satisfies the published schema.
+    jsonschema = pytest.importorskip("jsonschema")
+    import json
+
+    with open(os.path.join(CHART, "values.schema.json")) as f:
+        schema = json.load(f)
+    jsonschema.validate(values, schema)
+
+    # Single-replica default: replicaCount authoritative, no identity env.
+    manifests = render_chart(CHART, values={}, release_name="stack")
+    router = next(
+        m for m in _by_kind(manifests, "Deployment")
+        if m["metadata"]["name"].endswith("deployment-router")
+    )
+    assert router["spec"]["replicas"] == 1
+    c = _container(router, "router")
+    assert "POD_NAME" not in {e["name"] for e in c.get("env") or []}
+    assert "--router-id" not in [str(a) for a in c["args"]]

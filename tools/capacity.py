@@ -30,9 +30,17 @@ the concrete HPA targets for the exported autoscaling signals
 (Little's law: the concurrency one engine sustains at its SLO-headroom
 QPS) and the router-level ``router_queue_depth`` sum at each fleet size.
 
+With ``--router-report ROUTER_SWEEP_r*.json`` (bench.py --router-sweep,
+docs/ROUTER_SCALE.md) the model additionally folds in the ROUTER tier:
+the measured per-replica QPS ceiling becomes routers-per-QPS (how many
+stateless router replicas a fleet of each size needs, at the same SLO
+headroom) and the ``router_queue_depth`` HPA target for the router
+Deployment's own autoscaler.
+
 CLI:
     python -m tools.capacity MULTICHIP_r06.json [--target-qps N]
-        [--slo-headroom 0.9] [--max-engines 8] [--json]
+        [--slo-headroom 0.9] [--max-engines 8]
+        [--router-report ROUTER_SWEEP_r04.json] [--json]
 """
 
 import argparse
@@ -146,6 +154,49 @@ def capacity_model(
     }
 
 
+def router_tier_model(router_report: dict,
+                      slo_headroom: float = 0.9) -> dict:
+    """Pure function: router sweep report (bench.py --router-sweep) ->
+    the router tier's per-replica QPS ceiling. Conservative: takes the
+    WORST measured per-replica QPS across the sweep points (the marginal
+    replica buys at least this much), then applies the same SLO headroom
+    as the chip model."""
+    curve = router_report.get("curve") or []
+    per_replica = [
+        p["qps"] / p["routers"] for p in curve
+        if p.get("qps") and p.get("routers")
+    ]
+    if not per_replica:
+        raise ValueError("router report carries no measured sweep curve")
+    worst = min(per_replica)
+    return {
+        "measured_points": [
+            {"routers": p.get("routers"), "qps": p.get("qps")}
+            for p in curve
+        ],
+        "qps_per_router": round(worst, 3),
+        "qps_ceiling_per_router": round(worst * slo_headroom, 3),
+    }
+
+
+def fold_router_tier(model: dict, router_report: dict) -> dict:
+    """Fold a measured router-tier ceiling into a capacity model
+    (docs/ROUTER_SCALE.md): every table row gains the stateless router
+    replica count its QPS capacity needs, and the HPA targets gain the
+    per-replica ``router_queue_depth`` bound the router Deployment's own
+    autoscaler should hold (requests in flight per replica at its
+    headroom QPS — Little's law, same as the engine target)."""
+    tier = router_tier_model(router_report, model["slo_headroom"])
+    ceiling = tier["qps_ceiling_per_router"] or 1.0
+    for row in model["table"]:
+        row["routers"] = max(1, math.ceil(row["qps_capacity"] / ceiling))
+    model["router_tier"] = tier
+    model["hpa_targets"]["router_queue_depth_per_router"] = max(
+        1, math.floor(ceiling * model["avg_request_latency_s"])
+    )
+    return model
+
+
 def engines_for_qps(model: dict, target_qps: float) -> dict:
     """Smallest fleet (engines of the best measured mesh shape) whose
     capacity covers ``target_qps``, with the HPA budget it implies."""
@@ -157,7 +208,7 @@ def engines_for_qps(model: dict, target_qps: float) -> dict:
     if not per_engine:
         raise ValueError("model has no per-engine capacity row")
     engines = max(1, math.ceil(target_qps / per_engine))
-    return {
+    out = {
         "target_qps": target_qps,
         "engines": engines,
         "chips": engines * model["best_mesh_chips"],
@@ -166,6 +217,12 @@ def engines_for_qps(model: dict, target_qps: float) -> dict:
             "hpa_targets"
         ]["router_queue_depth_per_engine"],
     }
+    tier = model.get("router_tier")
+    if tier:
+        out["routers"] = max(1, math.ceil(
+            target_qps / (tier["qps_ceiling_per_router"] or 1.0)
+        ))
+    return out
 
 
 def _render_table(model: dict) -> str:
@@ -177,12 +234,18 @@ def _render_table(model: dict) -> str:
         f"{'chips':>6} {'engines':>8} {'tok/s':>10} {'eff':>6} "
         f"{'QPS':>9}  source",
     ]
+    with_routers = any("routers" in r for r in model["table"])
+    if with_routers:
+        lines[-1] += f" {'routers':>8}"
     for r in model["table"]:
-        lines.append(
+        line = (
             f"{r['chips']:>6} {r['engines']:>8} {r['tok_s']:>10.1f} "
             f"{r['scaling_efficiency']:>6.2f} {r['qps_capacity']:>9.2f}  "
-            f"{'measured' if r['measured'] else 'dp-extrapolated'}"
+            f"{'measured' if r['measured'] else 'dp-extrapolated':<15}"
         )
+        if with_routers:
+            line += f" {r.get('routers', 1):>8}"
+        lines.append(line)
     hpa = model["hpa_targets"]
     lines.append(
         f"HPA: pstpu_queue_depth per-engine target "
@@ -190,6 +253,14 @@ def _render_table(model: dict) -> str:
         f"router_queue_depth sum exceeds "
         f"{hpa['router_queue_depth_per_engine']} x engines"
     )
+    if "router_queue_depth_per_router" in hpa:
+        tier = model["router_tier"]
+        lines.append(
+            f"Router tier: {tier['qps_ceiling_per_router']} QPS per "
+            f"replica at headroom ({tier['qps_per_router']} measured); "
+            f"scale the router Deployment when router_queue_depth per "
+            f"replica exceeds {hpa['router_queue_depth_per_router']}"
+        )
     return "\n".join(lines)
 
 
@@ -208,6 +279,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "largest measured mesh")
     ap.add_argument("--target-qps", type=float, default=None,
                     help="also print the smallest fleet covering this QPS")
+    ap.add_argument("--router-report", default=None,
+                    help="ROUTER_SWEEP_r*.json (bench.py --router-sweep): "
+                         "fold the router tier's measured QPS ceiling in "
+                         "— routers per fleet size + the per-replica "
+                         "router_queue_depth HPA target "
+                         "(docs/ROUTER_SCALE.md)")
     ap.add_argument("--json", action="store_true",
                     help="emit the model as JSON instead of the table")
     args = ap.parse_args(argv)
@@ -217,6 +294,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     model = capacity_model(
         report, slo_headroom=args.slo_headroom, max_engines=args.max_engines
     )
+    if args.router_report:
+        with open(args.router_report) as f:
+            fold_router_tier(model, json.load(f))
     if args.target_qps is not None:
         model["provision"] = engines_for_qps(model, args.target_qps)
     if args.json:

@@ -292,8 +292,9 @@ REGISTRY: Tuple[Series, ...] = (
            router_labels=("server",)),
     Series("router_circuit_state", "gauge", (), (ROUTER,),
            ("catalogue", "resilience"),
-           "Circuit breaker state (0 closed / 1 open / 2 half-open)",
-           router_labels=("server",)),
+           "Circuit breaker state (0 closed / 1 open / 2 half-open); "
+           "router identifies the observing replica",
+           router_labels=("server", "router")),
     Series("router_deadline_exceeded_total", "counter", (), (ROUTER,),
            ("catalogue", "resilience"),
            "Deadline aborts (kind: ttft or total)",
@@ -303,7 +304,8 @@ REGISTRY: Tuple[Series, ...] = (
            ("catalogue", "resume"),
            "Mid-stream backend failures the router tried to resume on "
            "another backend (outcome: resumed = continuation spliced, "
-           "failed = no backend could attach)",
+           "failed = no backend could attach, peer = client reconnected "
+           "here after losing another router replica)",
            router_labels=("outcome",)),
     Series("router_truncations_total", "counter", (), (ROUTER,),
            ("catalogue", "resume"),
