@@ -378,9 +378,10 @@ _FUNCS = {
     "join": lambda r, f, ro, sep, v=None: sep.join(str(x) for x in (v or [])),
     "eq": lambda r, f, ro, a, b=None: a == b,
     "ne": lambda r, f, ro, a, b=None: a != b,
-    # Numeric comparisons (Go argument order: ``gt a b`` is a > b). Unset
-    # values compare as 0 so templates can gate on optional ints without
-    # a ``default`` wrapper (no parenthesized sub-expressions here).
+    # Numeric comparisons (Go argument order: ``gt a b`` is a > b). As in
+    # real Go templates, comparing nil is a TemplateError — gate optional
+    # ints with ``default`` first (no parenthesized sub-expressions here,
+    # so bind a ``$var := .Values.x | default 0`` and compare the var).
     "gt": lambda r, f, ro, a, b=None: _as_num(a) > _as_num(b),
     "ge": lambda r, f, ro, a, b=None: _as_num(a) >= _as_num(b),
     "lt": lambda r, f, ro, a, b=None: _as_num(a) < _as_num(b),
@@ -398,7 +399,13 @@ _FUNCS = {
 
 def _as_num(v: Any) -> float:
     if v is None:
-        return 0.0
+        # Real Go-template/Helm errors on nil comparisons ("invalid type
+        # for comparison"). Coercing to 0 here would let a template render
+        # in CI that breaks under real `helm template` — the exact class
+        # of drift helm_lite exists to catch.
+        raise TemplateError(
+            "cannot compare nil value (pipe through `default` first)"
+        )
     if isinstance(v, bool):
         return float(v)
     try:

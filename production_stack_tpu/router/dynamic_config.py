@@ -25,6 +25,22 @@ from production_stack_tpu.utils import (
 logger = init_logger(__name__)
 
 
+def _decay_remaining(open_circuits, age: float):
+    """Age a peer snapshot's remaining-open seconds by how long ago it was
+    published, so a frozen file converges to closed instead of re-opening
+    the circuit on every tick. Malformed entries pass through untouched —
+    apply_peer_state skips them."""
+    if age <= 0 or not isinstance(open_circuits, dict):
+        return open_circuits
+    out = {}
+    for url, rem in open_circuits.items():
+        try:
+            out[url] = float(rem) - age
+        except (TypeError, ValueError):
+            out[url] = rem
+    return out
+
+
 @dataclasses.dataclass
 class DynamicRouterConfig:
     service_discovery: Optional[str] = None
@@ -56,6 +72,12 @@ class DynamicConfigWatcher:
     peers' OPEN circuits (docs/ROUTER_SCALE.md). One watch interval is thus
     the worst-case time for replica B to learn a backend replica A already
     ejected — local observations still take effect immediately.
+
+    A dead/replaced replica stops republishing, so its file's frozen
+    ``remaining_s`` values must not be re-adopted forever: each payload
+    carries a wall-clock publish timestamp, remaining times are decayed by
+    the snapshot's age on read, snapshots older than a few watch intervals
+    are ignored outright, and long-dead files are garbage-collected.
     ``config_path`` may be None when only the peer plane is wanted."""
 
     def __init__(self, config_path: Optional[str],
@@ -106,25 +128,42 @@ class DynamicConfigWatcher:
             return
         os.makedirs(self.peer_dir, exist_ok=True)
         mine = f"breakers-{self.router_id}.json"
-        # Remaining-seconds deltas, not timestamps: monotonic clocks don't
-        # transfer between processes and wall clocks skew. Staleness is
-        # bounded by the watch interval; apply_remote_open clamps the rest.
-        payload = {"router_id": self.router_id,
+        now = time.time()
+        # Remaining-seconds deltas, not deadlines: monotonic clocks don't
+        # transfer between processes and wall clocks skew. The wall-clock
+        # ``ts`` only measures the SNAPSHOT's age (skew on the order of a
+        # watch interval is harmless); apply_remote_open clamps the rest.
+        payload = {"router_id": self.router_id, "ts": now,
                    "open": manager.peer_snapshot()}
         tmp = os.path.join(self.peer_dir, mine + ".tmp")
         with open(tmp, "w") as f:
             json.dump(payload, f)
         os.replace(tmp, os.path.join(self.peer_dir, mine))
+        # A live replica rewrites its file every tick; one that stopped is
+        # dead or replaced. Its frozen remaining_s must not re-open the
+        # circuit forever: decay by snapshot age, drop snapshots older
+        # than a few intervals, delete files long past that.
+        stale_after = max(3.0 * self.watch_interval, 15.0)
         for name in sorted(os.listdir(self.peer_dir)):
             if name == mine or not name.startswith("breakers-") \
                     or not name.endswith(".json"):
                 continue
+            path = os.path.join(self.peer_dir, name)
             try:
-                with open(os.path.join(self.peer_dir, name)) as f:
+                if now - os.stat(path).st_mtime > 4.0 * stale_after:
+                    os.remove(path)   # garbage-collect a long-dead replica
+                    continue
+                with open(path) as f:
                     peer = json.load(f)
+                try:
+                    age = max(0.0, now - float(peer.get("ts")))
+                except (TypeError, ValueError):
+                    age = max(0.0, now - os.stat(path).st_mtime)
+                if age > stale_after:
+                    continue
                 manager.apply_peer_state(
                     str(peer.get("router_id") or name),
-                    peer.get("open") or {},
+                    _decay_remaining(peer.get("open") or {}, age),
                 )
             except (OSError, ValueError):
                 continue   # partially-written / vanished peer file

@@ -24,6 +24,7 @@ is truncation-only — the backend is marked, never the bytes resent.
 """
 
 import random
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass
@@ -315,6 +316,14 @@ class ResilienceManager:
     def __init__(self, config: Optional[ResilienceConfig] = None):
         self.config = config or ResilienceConfig()
         self._breakers: Dict[str, CircuitBreaker] = {}
+        # The event loop drives allow/on_dispatch/record_*; the
+        # dynamic-config watcher THREAD drives peer_snapshot /
+        # apply_peer_state (docs/ROUTER_SCALE.md gossip). One lock
+        # serializes registry mutation and breaker state transitions
+        # across the two — iterating an unlocked dict the loop is
+        # concurrently inserting into raises RuntimeError and would drop
+        # a whole gossip tick.
+        self._lock = threading.Lock()
 
     def _breaker(self, url: str) -> CircuitBreaker:
         br = self._breakers.get(url)
@@ -323,26 +332,32 @@ class ResilienceManager:
         return br
 
     def allow(self, url: str) -> bool:
-        return self._breaker(url).allow()
+        with self._lock:
+            return self._breaker(url).allow()
 
     def on_dispatch(self, url: str) -> None:
-        self._breaker(url).on_dispatch()
+        with self._lock:
+            self._breaker(url).on_dispatch()
 
     def record_success(self, url: str) -> None:
-        self._breaker(url).record_success()
+        with self._lock:
+            self._breaker(url).record_success()
 
     def record_failure(self, url: str) -> None:
-        self._breaker(url).record_failure()
+        with self._lock:
+            self._breaker(url).record_failure()
 
     def state(self, url: str) -> int:
-        return self._breaker(url).state
+        with self._lock:
+            return self._breaker(url).state
 
     def snapshot(self) -> Dict[str, str]:
         """url -> state name, for the router's /health payload."""
-        return {
-            url: _STATE_NAMES[br.state]
-            for url, br in sorted(self._breakers.items())
-        }
+        with self._lock:
+            return {
+                url: _STATE_NAMES[br.state]
+                for url, br in sorted(self._breakers.items())
+            }
 
     # ------------------------------------------------ peer reconciliation
     def peer_snapshot(self) -> Dict[str, float]:
@@ -352,12 +367,13 @@ class ResilienceManager:
         processes where monotonic timestamps cannot."""
         now = time.monotonic()
         out = {}
-        for url, br in self._breakers.items():
-            if br.state != OPEN:
-                continue
-            rem = self.config.breaker_open_duration - (now - br._opened_at)
-            if rem > 0:
-                out[url] = round(rem, 3)
+        with self._lock:
+            for url, br in self._breakers.items():
+                if br.state != OPEN:
+                    continue
+                rem = self.config.breaker_open_duration - (now - br._opened_at)
+                if rem > 0:
+                    out[url] = round(rem, 3)
         return out
 
     def apply_peer_state(self, peer_id: str,
@@ -367,11 +383,12 @@ class ResilienceManager:
         files are best-effort, never load-bearing for correctness."""
         for url, rem in (open_circuits or {}).items():
             try:
-                self._breaker(str(url)).apply_remote_open(
-                    float(rem), peer_id
-                )
+                rem = float(rem)
+                url = str(url)
             except (TypeError, ValueError):
                 continue
+            with self._lock:
+                self._breaker(url).apply_remote_open(rem, peer_id)
 
 
 class SLOTracker:

@@ -522,6 +522,30 @@ class PrefixAwareRouter(RoutingInterface):
         )
         return self._kv_client
 
+    def _degraded_mode(self) -> str:
+        """What prefix-aware routing falls back to while the shared tier
+        is cooling down — with ``--kv-offload-url`` set, the local
+        /prefix_index scrape is disabled by default (docs/ROUTER_SCALE.md),
+        so a tier outage silently empties BOTH residency rungs unless the
+        operator re-enabled scraping. Name the actual degradation so the
+        log line tells the operator which ladder they are running on."""
+        if self._index_provider is not None:
+            return "local prefix-index snapshots"
+        from production_stack_tpu.router.stats.engine_stats import (
+            EngineStatsScraper,
+        )
+        from production_stack_tpu.utils.misc import SingletonMeta
+
+        # Peek the singleton registry rather than calling the accessor:
+        # get_engine_stats_scraper() CONSTRUCTS a default scraper (and its
+        # thread) when none exists — a log helper must not.
+        scraper = SingletonMeta._instances.get(EngineStatsScraper)
+        if scraper is not None and scraper.scrape_prefix_index:
+            return "local /prefix_index snapshots"
+        return ("session affinity/least-loaded ONLY — local /prefix_index "
+                "scraping is disabled, so no prefix placement until the "
+                "tier returns")
+
     def tier_restorable_blocks(self, hashes: List[bytes]) -> int:
         """Leading blocks of the prompt chain the shared offload tier
         holds, probing both dtype namespaces (bf16 bare keys and int8
@@ -546,8 +570,8 @@ class PrefixAwareRouter(RoutingInterface):
         except (ConnectionError, OSError) as e:
             logger.warning(
                 "shared KV tier unreachable (%s); prefix-aware routing "
-                "degrades to session affinity for %.0fs",
-                e, self.kv_down_cooldown,
+                "degrades to %s for %.0fs",
+                e, self._degraded_mode(), self.kv_down_cooldown,
             )
             self._kv_down_until = time.time() + self.kv_down_cooldown
             return 0
@@ -557,8 +581,9 @@ class PrefixAwareRouter(RoutingInterface):
             # failure does.
             logger.warning(
                 "shared KV tier index query took %.2fs; cooling the "
-                "restorability rung for %.0fs",
+                "restorability rung for %.0fs (degrading to %s)",
                 time.monotonic() - t0, self.kv_down_cooldown,
+                self._degraded_mode(),
             )
             self._kv_down_until = time.time() + self.kv_down_cooldown
         n = len(probe)

@@ -470,3 +470,21 @@ def test_router_replicas_wire_pod_name_router_id():
     c = _container(router, "router")
     assert "POD_NAME" not in {e["name"] for e in c.get("env") or []}
     assert "--router-id" not in [str(a) for a in c["args"]]
+
+def test_nil_numeric_comparison_is_a_template_error():
+    """Go-template parity: ``gt`` against an unset value must ERROR, not
+    coerce nil to 0 — real `helm template` fails these renders with
+    'invalid type for comparison', and helm_lite masking that let an
+    unguarded replicas gate ship. Templates gate optional ints by binding
+    a ``$var := .Values.x | default N`` first (deployment-router.yaml)."""
+    from production_stack_tpu.helm_lite import Renderer, TemplateError
+
+    r = Renderer(CHART, {})
+    with pytest.raises(TemplateError, match="nil"):
+        r.render_source("{{- if gt .Values.routerSpec.replicas 1 }}x{{- end }}")
+    # The guarded form both renderers accept:
+    out = r.render_source(
+        "{{- $n := .Values.routerSpec.replicas | default 1 }}"
+        "{{- if gt $n 1 }}multi{{- else }}single{{- end }}"
+    )
+    assert out == "single"

@@ -208,6 +208,65 @@ def test_breaker_gossip_roundtrip_through_peer_files(tmp_path):
         initialize_resilience(ResilienceConfig())   # reset the global
 
 
+def test_breaker_gossip_ignores_stale_and_decays_aged_peer_files(tmp_path):
+    """A dead/replaced replica's peer file stops being republished; its
+    frozen remaining_s must not re-open a recovered backend forever. The
+    reader decays remaining times by the snapshot's publish-timestamp age,
+    skips snapshots older than a few watch intervals, and garbage-collects
+    files long past that."""
+    import os
+    import time as _time
+
+    from production_stack_tpu.router.dynamic_config import (
+        DynamicConfigWatcher,
+    )
+    from production_stack_tpu.router.resilience import (
+        CLOSED, OPEN, ResilienceConfig, get_resilience,
+        initialize_resilience,
+    )
+    u_stale = "http://engine-stale:8000"
+    u_decayed = "http://engine-decayed:8000"
+    u_live = "http://engine-live:8000"
+    u_gc = "http://engine-gc:8000"
+    initialize_resilience(_resilience_cfg())
+    watcher = DynamicConfigWatcher(
+        None, watch_interval=10.0, peer_dir=str(tmp_path), router_id="r1",
+    )
+    try:
+        now = _time.time()
+        # Published 10 minutes ago (>> 3 watch intervals): skipped whole.
+        (tmp_path / "breakers-dead.json").write_text(json.dumps(
+            {"router_id": "dead", "ts": now - 600.0,
+             "open": {u_stale: 25.0}}
+        ))
+        # Fresh enough to read, but the 20s age eats the 5s remaining —
+        # the circuit converges to closed instead of flapping.
+        (tmp_path / "breakers-aging.json").write_text(json.dumps(
+            {"router_id": "aging", "ts": now - 20.0,
+             "open": {u_decayed: 5.0, u_live: 29.0}}
+        ))
+        # mtime far beyond the GC horizon: the file itself is deleted.
+        gc_file = tmp_path / "breakers-gone.json"
+        gc_file.write_text(json.dumps(
+            {"router_id": "gone", "ts": now, "open": {u_gc: 25.0}}
+        ))
+        os.utime(gc_file, (now - 7200.0, now - 7200.0))
+
+        watcher.sync_peer_state()
+        mgr = get_resilience()
+        assert mgr.state(u_stale) == CLOSED
+        assert mgr.state(u_decayed) == CLOSED
+        assert mgr.state(u_gc) == CLOSED
+        assert not gc_file.exists()
+        # The still-valid entry in the aging snapshot IS adopted, with its
+        # remaining time decayed by the snapshot's age.
+        assert mgr.state(u_live) == OPEN
+        assert mgr.peer_snapshot()[u_live] <= 29.0 - 20.0 + 0.5
+    finally:
+        watcher.close()
+        initialize_resilience(ResilienceConfig())   # reset the global
+
+
 # --------------------------------------------------------------------------
 # Client-driven cross-router resume (in-process router, fake engines)
 # --------------------------------------------------------------------------
