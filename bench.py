@@ -135,7 +135,10 @@ def _scrape_spec_metrics(engine_urls) -> dict:
     import urllib.request
 
     out = {"spec_enabled": 0.0, "spec_draft_tokens": 0.0,
-           "spec_accepted_tokens": 0.0}
+           "spec_accepted_tokens": 0.0, "spec_tree_nodes": 0.0,
+           "spec_gamma0_dispatches": 0.0, "spec_draft_depth": 0.0,
+           "spec_acceptance_rate_window": 0.0}
+    depth_samples = window_samples = 0
     for url in engine_urls:
         try:
             with urllib.request.urlopen(
@@ -155,6 +158,32 @@ def _scrape_spec_metrics(engine_urls) -> dict:
                 out["spec_draft_tokens"] += float(line.rsplit(" ", 1)[1])
             elif line.startswith("pstpu:spec_accepted_tokens_total"):
                 out["spec_accepted_tokens"] += float(line.rsplit(" ", 1)[1])
+            elif line.startswith("pstpu:spec_tree_nodes_total"):
+                out["spec_tree_nodes"] += float(line.rsplit(" ", 1)[1])
+            elif line.startswith("pstpu:spec_gamma0_dispatches_total"):
+                out["spec_gamma0_dispatches"] += float(
+                    line.rsplit(" ", 1)[1]
+                )
+            # Window rate MUST be matched before the bare acceptance-rate
+            # prefix: "pstpu:spec_acceptance_rate" is a startswith-prefix
+            # of the windowed series name.
+            elif line.startswith("pstpu:spec_acceptance_rate_window"):
+                out["spec_acceptance_rate_window"] += float(
+                    line.rsplit(" ", 1)[1]
+                )
+                window_samples += 1
+            elif line.startswith("pstpu:spec_draft_depth"):
+                out["spec_draft_depth"] += float(line.rsplit(" ", 1)[1])
+                depth_samples += 1
+    # Gauges average across engines (counters above simply sum).
+    if depth_samples:
+        out["spec_draft_depth"] = round(
+            out["spec_draft_depth"] / depth_samples, 4
+        )
+    if window_samples:
+        out["spec_acceptance_rate_window"] = round(
+            out["spec_acceptance_rate_window"] / window_samples, 4
+        )
     out["spec_acceptance_rate"] = round(
         out["spec_accepted_tokens"] / out["spec_draft_tokens"], 4
     ) if out["spec_draft_tokens"] else 0.0
@@ -277,6 +306,11 @@ def bench_stack(args) -> dict:
                str(getattr(args, "speculative_draft_window", None))]
               if getattr(args, "speculative_draft_window", None) is not None
               else []),
+            *(["--speculative-adaptive"]
+              if getattr(args, "speculative_adaptive", False) else []),
+            *(["--speculative-tree-width",
+               str(getattr(args, "speculative_tree_width", 1))]
+              if getattr(args, "speculative_tree_width", 1) != 1 else []),
         ],
         routing_logic=args.routing_logic,
         router_args=router_args,
@@ -737,6 +771,8 @@ def bench_engine(args) -> dict:
         **({"speculative_draft_window": args.speculative_draft_window}
            if getattr(args, "speculative_draft_window", None) is not None
            else {}),
+        speculative_adaptive=getattr(args, "speculative_adaptive", False),
+        speculative_tree_width=getattr(args, "speculative_tree_width", 1),
     )
     engine = ServingEngine(cfg)
 
@@ -766,7 +802,223 @@ def bench_engine(args) -> dict:
             "spec_acceptance_rate": round(
                 st.get("spec_acceptance_rate", 0.0), 4
             ),
+            "spec_acceptance_rate_window": round(
+                st.get("spec_acceptance_rate_window", 0.0), 4
+            ),
+            "spec_draft_depth": round(st.get("spec_draft_depth", 0.0), 4),
+            "spec_tree_nodes": st.get("spec_tree_nodes_total", 0),
+            "spec_gamma0_dispatches": st.get(
+                "spec_gamma0_dispatches_total", 0
+            ),
         },
+    }
+
+
+def _spec_runner_snapshot(engine) -> dict:
+    """Cumulative speculative counters straight off the in-process runner
+    (the A/B diffs these around each workload, so per-workload acceptance
+    and served depth are exact rather than lifetime means)."""
+    r = engine.runner
+    return {
+        "drafts": int(getattr(r, "spec_draft_tokens_total", 0)),
+        "accepted": int(getattr(r, "spec_accepted_tokens_total", 0)),
+        "cycles": int(getattr(r, "spec_live_cycles_total", 0)),
+        "tree_nodes": int(getattr(r, "spec_tree_nodes_total", 0)),
+        "gamma0_dispatches": int(
+            getattr(r, "spec_gamma0_dispatches_total", 0)
+        ),
+    }
+
+
+def bench_speculative_ab(args) -> dict:
+    """Acceptance-limited speculative A/B (docs/PERF.md round 10; the
+    BENCH_r10 evidence shape): the SAME seeded workload through four
+    in-process engine configs — spec-off, fixed linear-gamma, token-tree
+    verify, and adaptive per-sequence gamma — comparing effective emitted
+    tokens per target-model step and asserting token-identical outputs
+    across all four (greedy AND seeded: round 8's determinism bar,
+    extended over the tree/adaptive paths).
+
+    Two workload axes per mode:
+      * cache_friendly — greedy continuation, where a (windowed) draft
+        tracks the target closely and linear chains already accept deep;
+      * acceptance_limited — per-user seeded temperature sampling, where
+        the target's own sampled path diverges from the draft chain after
+        the first position, so depth stops paying and first-position
+        BREADTH (tree alternates) or backing off (adaptive gamma) is the
+        only way to keep effective tokens up.
+
+    Effective tokens per target step is computed exactly from runner
+    counter deltas: 1 + accepted / live_cycles (every live speculative
+    cycle emits the accepted prefix plus the target's own bonus token).
+    """
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.engine import ServingEngine
+    from production_stack_tpu.engine.sampling import SamplingParams
+
+    import jax
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    n_spec = getattr(args, "speculative_num_tokens", 0) or 3
+    tree_w = getattr(args, "speculative_tree_width", 1)
+    if tree_w <= 1:
+        tree_w = 3
+    draft = getattr(args, "speculative_model", None) or args.model
+    spec_base = {
+        "speculative_num_tokens": n_spec,
+        "speculative_model": draft,
+        **({"speculative_draft_window": args.speculative_draft_window}
+           if getattr(args, "speculative_draft_window", None) is not None
+           else {}),
+    }
+    modes = [
+        ("off", {}),
+        ("linear", dict(spec_base)),
+        ("tree", dict(spec_base, speculative_tree_width=tree_w)),
+        ("adaptive", dict(spec_base, speculative_tree_width=tree_w,
+                          speculative_adaptive=True)),
+    ]
+
+    users = max(1, args.users)
+    system = "You are a helpful assistant. " * max(1, args.prompt_len // 30)
+    prompts = [
+        system + f" user {u} ab-round: please continue the story."
+        for u in range(users)
+    ]
+    workloads = [
+        ("cache_friendly", [
+            SamplingParams(temperature=0.0, max_tokens=args.max_tokens,
+                           ignore_eos=True)
+            for _ in range(users)
+        ]),
+        # Moderate temperature: the target's sampled path diverges from
+        # the draft chain (acceptance-limited) while keeping enough mass
+        # concentration that a diverging sample often still sits in the
+        # draft's top-k — the regime first-position BREADTH salvages.
+        ("acceptance_limited", [
+            SamplingParams(temperature=0.4, max_tokens=args.max_tokens,
+                           ignore_eos=True, seed=7000 + u)
+            for u in range(users)
+        ]),
+    ]
+
+    async def _collect(engine, prompt, sampling):
+        toks = []
+        async for out in engine.generate(prompt=prompt, sampling=sampling):
+            if out.token_ids:
+                toks = list(out.token_ids)
+        return toks
+
+    async def _run_mode(cfg_kwargs):
+        cfg = EngineConfig(
+            model=args.model,
+            max_model_len=args.max_model_len,
+            block_size=16,
+            max_num_seqs=max(8, users),
+            max_num_batched_tokens=1024,
+            num_kv_blocks=None if on_tpu else 2048,
+            kv_cache_dtype=args.kv_cache_dtype,
+            **cfg_kwargs,
+        )
+        engine = ServingEngine(cfg)
+        await engine.start()
+        try:
+            mode_res = {"workloads": {}, "outputs": {}}
+            for wl_name, samplings in workloads:
+                s0 = _spec_runner_snapshot(engine)
+                t0 = time.monotonic()
+                outs = await asyncio.gather(*[
+                    _collect(engine, prompts[u], samplings[u])
+                    for u in range(users)
+                ])
+                elapsed = time.monotonic() - t0
+                s1 = _spec_runner_snapshot(engine)
+                d = {k: s1[k] - s0[k] for k in s0}
+                cycles = d["cycles"]
+                total_out = sum(len(t) for t in outs)
+                mode_res["outputs"][wl_name] = outs
+                mode_res["workloads"][wl_name] = {
+                    "output_tok_s": round(total_out / elapsed, 2),
+                    "total_output_tokens": total_out,
+                    "spec_draft_tokens": d["drafts"],
+                    "spec_accepted_tokens": d["accepted"],
+                    "spec_live_cycles": cycles,
+                    "spec_tree_nodes": d["tree_nodes"],
+                    "spec_gamma0_dispatches": d["gamma0_dispatches"],
+                    "spec_acceptance_rate": round(
+                        d["accepted"] / d["drafts"], 4
+                    ) if d["drafts"] else 0.0,
+                    "spec_draft_depth": round(
+                        d["drafts"] / cycles, 4
+                    ) if cycles else 0.0,
+                    "effective_tokens_per_target_step": round(
+                        1.0 + d["accepted"] / cycles, 4
+                    ) if cycles else 1.0,
+                }
+            return mode_res
+        finally:
+            await engine.stop()
+
+    results = {}
+    outputs = {}
+    for name, cfg_kwargs in modes:
+        res = asyncio.run(_run_mode(cfg_kwargs))
+        outputs[name] = res.pop("outputs")
+        results[name] = res["workloads"]
+        print(json.dumps({"speculative_ab_point": {name: results[name]}}),
+              file=sys.stderr)
+
+    # Token-identity bar: every speculative mode must emit EXACTLY the
+    # spec-off tokens, greedy and seeded alike (speculation is a latency
+    # optimization, never a sampling change).
+    identity = {
+        name: all(
+            outputs[name][wl] == outputs["off"][wl]
+            for wl, _ in workloads
+        )
+        for name in outputs if name != "off"
+    }
+    eff = {
+        name: {
+            wl: results[name][wl]["effective_tokens_per_target_step"]
+            for wl, _ in workloads
+        }
+        for name in results
+    }
+    bar = {
+        "tree_ge_linear_acceptance_limited":
+            eff["tree"]["acceptance_limited"]
+            >= eff["linear"]["acceptance_limited"],
+        "adaptive_ge_linear_acceptance_limited":
+            eff["adaptive"]["acceptance_limited"]
+            >= eff["linear"]["acceptance_limited"],
+        "tree_no_regression_cache_friendly":
+            eff["tree"]["cache_friendly"]
+            >= eff["linear"]["cache_friendly"] - 0.05,
+        "adaptive_no_regression_cache_friendly":
+            eff["adaptive"]["cache_friendly"]
+            >= eff["linear"]["cache_friendly"] - 0.05,
+    }
+    return {
+        "metric": f"speculative_ab_{args.model}",
+        "backend": args.backend,
+        "model": args.model,
+        "speculative_model": draft,
+        "speculative_num_tokens": n_spec,
+        "speculative_tree_width": tree_w,
+        **({"speculative_draft_window": args.speculative_draft_window}
+           if getattr(args, "speculative_draft_window", None) is not None
+           else {}),
+        "workload": {
+            "users": users,
+            "max_tokens": args.max_tokens,
+            "prompt_len_words": args.prompt_len,
+        },
+        "modes": results,
+        "effective_tokens_per_target_step": eff,
+        "token_identical": identity,
+        "bar": bar,
+        "errors_total": 0,
     }
 
 
@@ -878,6 +1130,28 @@ def main():
                          "(0 = full draft context — the BENCH_r08 "
                          "self-draft evidence shape; default: engine "
                          "tuned value)")
+    ap.add_argument("--speculative-adaptive", action="store_true",
+                    help="per-sequence adaptive draft depth: an "
+                         "acceptance EMA picks each row's gamma per "
+                         "dispatch, degrading to the spec-off dispatch "
+                         "when every row sits at gamma=0 "
+                         "(docs/PERF.md round 10)")
+    ap.add_argument("--speculative-tree-width", type=int, default=1,
+                    help="token-tree verification width: top-k branching "
+                         "at the first draft position, verified in one "
+                         "batched target pass (1 = linear chain; "
+                         "docs/PERF.md round 10)")
+    ap.add_argument("--speculative-ab", action="store_true",
+                    help="acceptance-limited speculative A/B: run the "
+                         "SAME seeded workload through spec-off, fixed "
+                         "linear-gamma, tree, and adaptive engine configs "
+                         "in-process, compare effective tokens per target "
+                         "step and assert token-identical outputs "
+                         "(BENCH_r10 evidence shape; implies --mode "
+                         "engine)")
+    ap.add_argument("--speculative-ab-output", default=None,
+                    help="also write the --speculative-ab report JSON "
+                         "here (e.g. BENCH_r10.json)")
     ap.add_argument("--disagg", action="store_true",
                     help="prefill/decode disaggregation smoke: 1-prefill + "
                          "1-decode stack over a shared kv_offload store, "
@@ -1023,6 +1297,23 @@ def main():
                 f.write("\n")
         return 0
 
+    if getattr(args, "speculative_ab", False):
+        args.mode = "engine"  # four in-process engines, one per spec mode
+        _force_virtual_devices(args, args.tensor_parallel_size)
+        report = bench_speculative_ab(args)
+        print(json.dumps(report))
+        if args.speculative_ab_output:
+            with open(args.speculative_ab_output, "w") as f:
+                json.dump(report, f, indent=1)
+                f.write("\n")
+        if not all(report["token_identical"].values()):
+            raise RuntimeError(
+                f"speculative A/B broke token identity: "
+                f"{report['token_identical']} — speculation must never "
+                f"change emitted tokens"
+            )
+        return 0
+
     _force_virtual_devices(args, args.tensor_parallel_size)
     if args.disagg:
         args.mode = "stack"  # disagg is a stack-shape run (JSON line parity)
@@ -1053,10 +1344,17 @@ def _result_line(args, res) -> dict:
     eff_tokens = 1.0
     if spec.get("spec_enabled"):
         # Effective emitted tokens per target-model step: every cycle
-        # emits the accepted drafts plus the target's own sample.
+        # emits the accepted drafts plus the target's own sample. Under
+        # adaptive gamma the SERVED draft depth (drafts / live cycles) is
+        # the honest multiplier — the configured N overstates a
+        # controller that throttled rows to shallow gammas (docs/PERF.md
+        # round 10). With no depth telemetry (older engine) fall back to
+        # the configured depth.
+        depth = float(spec.get("spec_draft_depth", 0.0)) or float(
+            args.speculative_num_tokens
+        )
         eff_tokens = 1.0 + (
-            spec.get("spec_acceptance_rate", 0.0)
-            * args.speculative_num_tokens
+            spec.get("spec_acceptance_rate", 0.0) * depth
         )
     # Total chips across the deployment: tp devices per engine mesh x the
     # engine replica count (the disagg shape is a fixed 1-prefill +
@@ -1112,6 +1410,24 @@ def _result_line(args, res) -> dict:
         "spec_draft_tokens": int(spec.get("spec_draft_tokens", 0)),
         "spec_accepted_tokens": int(spec.get("spec_accepted_tokens", 0)),
         "spec_acceptance_rate": spec.get("spec_acceptance_rate", 0.0),
+        # Round 10 companions: windowed acceptance (recent trains only),
+        # the mean SERVED draft depth the adaptive controller actually
+        # dispatched, tree verification node volume, and how often the
+        # all-gamma=0 degrade path took the spec-off dispatch.
+        "speculative_adaptive": bool(
+            getattr(args, "speculative_adaptive", False)
+        ),
+        "speculative_tree_width": int(
+            getattr(args, "speculative_tree_width", 1)
+        ),
+        "spec_acceptance_rate_window": spec.get(
+            "spec_acceptance_rate_window", 0.0
+        ),
+        "spec_draft_depth": spec.get("spec_draft_depth", 0.0),
+        "spec_tree_nodes": int(spec.get("spec_tree_nodes", 0)),
+        "spec_gamma0_dispatches": int(
+            spec.get("spec_gamma0_dispatches", 0)
+        ),
         "effective_tokens_per_target_step": round(eff_tokens, 4),
     }
     if args.mode == "stack":

@@ -168,6 +168,31 @@ class EngineConfig:
     # the draft forgetting distant context (acceptance-only effect,
     # never correctness).
     speculative_draft_window: int = 1024
+    # Adaptive per-sequence draft depth (docs/PERF.md round 10): a host-side
+    # per-sequence acceptance EMA picks each row's draft depth gamma in
+    # [0, speculative_num_tokens] at every dispatch — high-acceptance rows
+    # draft deep, low-acceptance rows shrink toward gamma=0, and a dispatch
+    # whose rows ALL sit at gamma=0 is issued down the plain non-speculative
+    # path (zero draft steps, zero draft-ring traffic). Output stays
+    # token-identical to spec-off/fixed-gamma: acceptance only ever gates
+    # which DRAFT proposals may be accepted, never what the target samples.
+    speculative_adaptive: bool = False
+    # Token-tree draft width W (SpecInfer, arXiv:2305.09781): the draft
+    # proposes W alternatives at the FIRST speculated position (the seeded
+    # common-random-number sample plus the top W-1 other draft tokens) and
+    # a linear continuation behind the first, all verified in ONE batched
+    # target pass with the tree encoded as an additive attention-bias
+    # segment. 1 = linear speculation (exactly the round-8 path).
+    speculative_tree_width: int = 1
+    # Adaptive-controller shape knobs (config-only; the two serving flags
+    # above are the operator surface). ema_decay is the weight of the
+    # newest per-dispatch acceptance observation; gamma_threshold is the
+    # expected-value floor (gamma = largest g with ema^g >= threshold);
+    # probe_period re-probes a gamma=0 row with gamma=1 every P dispatches
+    # so collapsed rows can recover (0 disables probing).
+    speculative_ema_decay: float = 0.35
+    speculative_gamma_threshold: float = 0.5
+    speculative_probe_period: int = 16
     # --- weights ---
     load_format: str = "auto"               # "auto" | "safetensors" | "dummy"
     seed: int = 0
@@ -211,6 +236,14 @@ class EngineConfig:
         # the draft resolution so the spec+tp pairing gets the error that
         # names both flags.
         self.validate_parallelism()
+        if not self.speculative_num_tokens and (
+            self.speculative_adaptive or self.speculative_tree_width > 1
+        ):
+            raise ValueError(
+                "--speculative-adaptive/--speculative-tree-width modify the "
+                "speculative decode train and require "
+                "--speculative-num-tokens > 0 (plus --speculative-model)"
+            )
         if self.speculative_num_tokens:
             self.resolved_draft_config()
 
@@ -302,6 +335,31 @@ class EngineConfig:
                 "speculative decoding currently requires a single-device "
                 "mesh (tp=sp=1) — the draft-KV ring pools and the batched "
                 "verify chunk are not mesh-sharded yet"
+            )
+        w = self.speculative_tree_width
+        if w < 1 or w > 8:
+            raise ValueError(
+                f"--speculative-tree-width must be in [1, 8], got {w} "
+                f"(width 1 is linear speculation; wider trees multiply "
+                f"verify-chunk FLOPs with sharply diminishing acceptance "
+                f"returns past the first few alternatives)"
+            )
+        if not 0.0 < self.speculative_ema_decay <= 1.0:
+            raise ValueError(
+                f"speculative_ema_decay must be in (0, 1], got "
+                f"{self.speculative_ema_decay}"
+            )
+        if self.speculative_gamma_threshold <= 0.0:
+            raise ValueError(
+                f"speculative_gamma_threshold must be > 0, got "
+                f"{self.speculative_gamma_threshold} (values > 1 pin every "
+                f"row to gamma=0 — the spec-off-degradation test "
+                f"configuration)"
+            )
+        if self.speculative_probe_period < 0:
+            raise ValueError(
+                f"speculative_probe_period must be >= 0, got "
+                f"{self.speculative_probe_period}"
             )
         target = resolve_model_config(self.model)
         draft = resolve_model_config(self.speculative_model)

@@ -598,13 +598,21 @@ class ServingEngine:
                     "start": batch.chunk_starts[idx],
                 }, t=t_wall)
             else:
-                rec.event(seq.request_id, "decode_issue", {
+                data = {
                     "step": step, "rows": len(batch.seqs),
                     "k": batch.num_steps,
-                }, t=t_wall)
+                }
+                if getattr(batch, "spec_mode", "off") != "off":
+                    # Which speculative variant the runner actually
+                    # dispatched (linear/tree/adaptive/off-degrade) —
+                    # gamma=0 degradation is invisible in token counts
+                    # alone.
+                    data["spec_mode"] = batch.spec_mode
+                rec.event(seq.request_id, "decode_issue", data, t=t_wall)
 
     def _record_fetch(self, batch, step: int, token_lists,
-                      issue_time: float, spec_accepted_delta: int) -> None:
+                      issue_time: float, spec_accepted_delta: int,
+                      spec_drafts_delta: int = 0) -> None:
         """Dispatch-fetch anchor: per-train decode cadence histogram +
         per-request fetch events (tokens emitted, spec acceptance)."""
         now = time.monotonic()
@@ -636,6 +644,12 @@ class ServingEngine:
                     # across requests of one batch would overcount, so
                     # the key says so.
                     data["spec_accepted_batch"] = spec_accepted_delta
+                if spec_drafts_delta:
+                    # Drafted alongside accepted: the pair gives a
+                    # per-dispatch acceptance ratio in the recorder
+                    # timeline (adaptive gamma makes the denominator
+                    # variable — accepted alone no longer implies it).
+                    data["spec_drafts_batch"] = spec_drafts_delta
                 rec.event(seq.request_id, "decode_fetch", data)
 
     # ----------------------------------------------------------- fast-start
@@ -757,6 +771,8 @@ class ServingEngine:
                 self.overlapped_fetches_total += 1
             spec0 = (self.runner.spec_accepted_tokens_total
                      if cfg.speculative_num_tokens else 0)
+            spec_d0 = (self.runner.spec_draft_tokens_total
+                       if cfg.speculative_num_tokens else 0)
             try:
                 tokens, lps = await loop.run_in_executor(None, handle.fetch)
             except Exception:  # noqa: BLE001 — engine loop must survive
@@ -770,6 +786,8 @@ class ServingEngine:
             self._record_fetch(
                 batch, step, tokens, handle.issue_time,
                 (self.runner.spec_accepted_tokens_total - spec0)
+                if cfg.speculative_num_tokens else 0,
+                (self.runner.spec_draft_tokens_total - spec_d0)
                 if cfg.speculative_num_tokens else 0,
             )
             self.last_step_time = self._last_fetch_done = time.monotonic()
@@ -1241,6 +1259,18 @@ class ServingEngine:
             "spec_accepted_tokens_total":
                 self.runner.spec_accepted_tokens_total,
             "spec_acceptance_rate": self.runner.spec_acceptance_rate,
+            # Round 10: windowed acceptance (last <=64 fetches — the
+            # lifetime rate freezes after long uptimes), served draft
+            # depth under the adaptive controller, tree-node volume, the
+            # mean per-sequence acceptance EMA, and how often the
+            # controller degraded a whole dispatch to the plain scan.
+            "spec_acceptance_rate_window":
+                self.runner.spec_acceptance_rate_window,
+            "spec_draft_depth": self.runner.spec_draft_depth_mean,
+            "spec_tree_nodes_total": self.runner.spec_tree_nodes_total,
+            "spec_acceptance_ema": self.runner.spec_acceptance_ema_mean,
+            "spec_gamma0_dispatches_total":
+                self.runner.spec_gamma0_dispatches_total,
             # Elastic fast-start (docs/ELASTIC.md): startup phase timings
             # + the warmup persistent-compile-cache hit/miss split.
             "startup_weight_load_seconds":

@@ -175,6 +175,7 @@ def phases(rec: FlightRecord) -> List[dict]:
     decode_trains = 0
     decode_tokens = 0
     spec_accepted = 0   # batch-level sum over trains (see decode_fetch)
+    spec_drafts = 0     # batch-level drafted sum (variable under gamma)
     enqueue_t = None
     restore_tokens = 0
     restore_seconds = 0.0
@@ -203,6 +204,7 @@ def phases(rec: FlightRecord) -> List[dict]:
             # dispatch, not per row) — the phase attr keeps the _batch
             # suffix so nobody reads it as this request's own count.
             spec_accepted += int(data.get("spec_accepted_batch", 0))
+            spec_drafts += int(data.get("spec_drafts_batch", 0))
         elif name == "restore":
             secs = float(data.get("seconds", 0.0))
             restore_tokens += int(data.get("tokens", 0))
@@ -247,6 +249,11 @@ def phases(rec: FlightRecord) -> List[dict]:
                                     "tokens": decode_tokens}
         if spec_accepted:
             attrs["spec_accepted_batch"] = spec_accepted
+        if spec_drafts:
+            # Denominator companion: adaptive gamma makes the per-train
+            # draft count variable, so acceptance is no longer derivable
+            # from spec_accepted_batch alone.
+            attrs["spec_drafts_batch"] = spec_drafts
         out.append({
             "name": "decode", "start": round(decode_start, 6),
             "end": round(decode_end if decode_end is not None

@@ -135,6 +135,25 @@ class EngineMetricsCollector(Collector):
                     "Lifetime fraction of draft proposals accepted by "
                     "the target",
                     getattr(runner, "spec_acceptance_rate", 0.0))
+        yield gauge("pstpu:spec_acceptance_rate_window",
+                    "Draft acceptance over the last <=64 dispatch fetches "
+                    "(windowed companion to the lifetime rate)",
+                    getattr(runner, "spec_acceptance_rate_window", 0.0))
+        yield gauge("pstpu:spec_draft_depth",
+                    "Mean served draft depth per live verify cycle "
+                    "(adaptive gamma controller)",
+                    getattr(runner, "spec_draft_depth_mean", 0.0))
+        yield counter("pstpu:spec_tree_nodes_total",
+                      "Token-tree nodes verified (tree speculation)",
+                      getattr(runner, "spec_tree_nodes_total", 0))
+        yield gauge("pstpu:spec_acceptance_ema",
+                    "Mean per-sequence acceptance EMA over live sequences "
+                    "(adaptive controller)",
+                    getattr(runner, "spec_acceptance_ema_mean", 0.0))
+        yield counter("pstpu:spec_gamma0_dispatches_total",
+                      "Decode dispatches the adaptive controller degraded "
+                      "to the plain (non-speculative) scan",
+                      getattr(runner, "spec_gamma0_dispatches_total", 0))
         # Elastic fast-start (docs/ELASTIC.md) — the text renderer exports
         # the same seven series (PL004 keeps them aligned).
         yield gauge("pstpu:startup_weight_load_seconds",

@@ -24,14 +24,14 @@ was profiled on a v5e in round 1/2):
 
 import functools
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from production_stack_tpu.engine.config import EngineConfig
-from production_stack_tpu.engine.sampling import sample_tokens
+from production_stack_tpu.engine.sampling import sample_tokens, sampling_scores
 from production_stack_tpu.engine.scheduler import ScheduledBatch, Sequence
 from production_stack_tpu.models import get_model_fns
 from production_stack_tpu.models.config import ModelConfig
@@ -59,8 +59,11 @@ _POS_SENTINEL = np.int32(2**30)  # ring_pos value for not-yet-written entries
 # device->host sync (~100 ms of tunnel RTT on the benched deployment, the
 # dominant serving cost) then overlaps N+1's execution. Row 12 is the
 # sequence's slot in the speculative draft-KV ring pools (0 when
-# speculative decoding is off — the row is then never read).
-NUM_SCALARS = 13
+# speculative decoding is off — the row is then never read). Row 13 is the
+# per-row speculative draft depth gamma in [0, speculative_num_tokens]
+# (the round-10 adaptive controller's output; packed as N itself when the
+# controller is off, never read without speculation).
+NUM_SCALARS = 14
 # Static buckets for the per-dispatch top-logprobs width: OpenAI completions
 # allows logprobs<=5, chat top_logprobs<=20; two buckets bound the compiled
 # variant count. 0 = the (default) no-logprobs variants.
@@ -104,6 +107,71 @@ def _token_seed(seq: Sequence, gen_index: int) -> np.uint32:
     return np.uint32(
         (int(_seed_base(seq)) * int(_SEED_MULT) + gen_index) & 0xFFFFFFFF
     )
+
+
+class SpecGammaController:
+    """Host-side per-sequence draft-depth controller (docs/PERF.md round
+    10). Tracks an acceptance EMA per request from the per-row
+    draft/accept counts every speculative dispatch already fetches, and
+    picks each row's next draft depth gamma with sampling.adaptive_gamma
+    (largest g with ema^g >= threshold — Leviathan'23's expected-value
+    model applied per sequence). Rows that collapse to gamma=0 are
+    re-probed with gamma=1 every ``probe_period`` dispatches so a
+    sequence whose output turns predictable again can recover. Purely
+    deterministic given the observation trace — the EMA-convergence test
+    drives it with a scripted one."""
+
+    def __init__(self, n_max: int, decay: float, threshold: float,
+                 probe_period: int):
+        self.n_max = n_max
+        self.decay = decay
+        self.threshold = threshold
+        self.probe_period = probe_period
+        self._ema: Dict[str, float] = {}
+        self._since_probe: Dict[str, int] = {}
+
+    def update(self, request_id: str, drafted: int, accepted: int) -> None:
+        """Fold one dispatch's (drafted, accepted) counts for a request
+        into its EMA. A gamma=0 dispatch drafts nothing and is NOT an
+        observation (the EMA must not drift on no data)."""
+        if drafted <= 0:
+            return
+        obs = min(1.0, accepted / drafted)
+        prev = self._ema.get(request_id, 1.0)
+        self._ema[request_id] = (
+            (1.0 - self.decay) * prev + self.decay * obs
+        )
+
+    def gamma(self, request_id: str) -> int:
+        """Draft depth for the request's NEXT dispatch (optimistic full
+        depth before the first observation)."""
+        from production_stack_tpu.engine.sampling import adaptive_gamma
+
+        g = adaptive_gamma(
+            self._ema.get(request_id, 1.0), self.n_max, self.threshold
+        )
+        if g == 0 and self.probe_period > 0:
+            waited = self._since_probe.get(request_id, 0) + 1
+            if waited >= self.probe_period:
+                self._since_probe[request_id] = 0
+                return 1
+            self._since_probe[request_id] = waited
+        return g
+
+    def ema(self, request_id: str) -> float:
+        return self._ema.get(request_id, 1.0)
+
+    def forget(self, request_id: str) -> None:
+        self._ema.pop(request_id, None)
+        self._since_probe.pop(request_id, None)
+
+    def mean_ema(self) -> float:
+        """Mean acceptance EMA over live (tracked) sequences — the
+        pstpu:spec_acceptance_ema gauge (one gauge, not a per-request
+        label set: request ids are unbounded-cardinality)."""
+        if not self._ema:
+            return 0.0
+        return sum(self._ema.values()) / len(self._ema)
 
 
 _cache_configured_dir: Optional[str] = None
@@ -348,11 +416,59 @@ class ModelRunner:
             # and how many survived verification.
             self.spec_draft_tokens_total = 0
             self.spec_accepted_tokens_total = 0
+            # --- round 10: tree verify + adaptive per-row gamma --------
+            self.spec_tree_width = int(config.speculative_tree_width)
+            if self.spec_tree_width > 1:
+                from production_stack_tpu.ops.tree_mask import (
+                    main_chain_indices, tree_attention_bias, tree_structure,
+                )
+
+                parents, depths = tree_structure(
+                    self.spec_n, self.spec_tree_width
+                )
+                self._spec_tree_parents = parents        # np [T]
+                self._spec_tree_depths = depths          # np [T]
+                self._spec_tree_bias = jnp.asarray(
+                    tree_attention_bias(parents)
+                )                                        # [T, T] f32
+                self._spec_main_chain = main_chain_indices(
+                    self.spec_n, self.spec_tree_width
+                )                                        # np [N+1]
+            self.spec_adaptive = bool(config.speculative_adaptive)
+            self._spec_controller = (
+                SpecGammaController(
+                    self.spec_n,
+                    config.speculative_ema_decay,
+                    config.speculative_gamma_threshold,
+                    config.speculative_probe_period,
+                ) if self.spec_adaptive else None
+            )
+            # Tree/depth telemetry: lifetime tree-node counter, served
+            # draft-depth accumulators (sum of per-row gammas over live
+            # verify cycles), gamma=0 full-degrade dispatch counter, and
+            # a windowed per-fetch (drafts, accepted) deque behind
+            # pstpu:spec_acceptance_rate_window (mirrors the router
+            # engine_stats delta scraper: lifetime counters alone can't
+            # show "acceptance collapsed five minutes ago").
+            self.spec_tree_nodes_total = 0
+            self.spec_draft_depth_sum = 0
+            self.spec_live_cycles_total = 0
+            self.spec_gamma0_dispatches_total = 0
+            from collections import deque
+
+            self._spec_window: "deque[Tuple[int, int]]" = deque(maxlen=64)
         else:
             self.spec_params = None
             self.spec_ring_len = 1
             self.spec_draft_tokens_total = 0
             self.spec_accepted_tokens_total = 0
+            self.spec_tree_width = 1
+            self.spec_adaptive = False
+            self._spec_controller = None
+            self.spec_tree_nodes_total = 0
+            self.spec_draft_depth_sum = 0
+            self.spec_live_cycles_total = 0
+            self.spec_gamma0_dispatches_total = 0
 
         self.num_kv_blocks = num_kv_blocks or config.num_kv_blocks or \
             self._derive_num_blocks()
@@ -371,7 +487,7 @@ class ModelRunner:
         self._decode = jax.jit(
             self._decode_impl,
             static_argnames=("b", "mb", "num_steps", "use_cached_window",
-                             "has_penalties", "logprobs_k"),
+                             "has_penalties", "logprobs_k", "spec_on"),
             donate_argnums=(2, 3, 4, 5, 6, 7, 11, 12, 13),
         )
         # Persistent decode window (window impl only): consecutive decode
@@ -581,6 +697,8 @@ class ModelRunner:
         if not self.spec_n:
             return
         self._spec_warmed.pop(request_id, None)
+        if self._spec_controller is not None:
+            self._spec_controller.forget(request_id)
         slot = self._spec_slots.pop(request_id, None)
         if slot is not None:
             self._spec_free.append(slot)
@@ -698,6 +816,36 @@ class ModelRunner:
         if not self.spec_draft_tokens_total:
             return 0.0
         return self.spec_accepted_tokens_total / self.spec_draft_tokens_total
+
+    @property
+    def spec_acceptance_rate_window(self) -> float:
+        """Acceptance over the last <=64 fetches only — the windowed
+        companion to the lifetime ``spec_acceptance_rate`` (which a long
+        uptime freezes: an hour of 0.8 acceptance hides a collapse to
+        0.1 for many minutes). Same delta-window idea as the router's
+        engine_stats per-interval cache-hit scraper."""
+        drafts = sum(d for d, _ in self._spec_window) if self.spec_n else 0
+        if not drafts:
+            return 0.0
+        return sum(a for _, a in self._spec_window) / drafts
+
+    @property
+    def spec_draft_depth_mean(self) -> float:
+        """Mean SERVED draft depth per live verify cycle (sum of per-row
+        gammas / live cycles). Equals speculative_num_tokens exactly in
+        fixed mode; under the adaptive controller it is the actual depth
+        the fleet is paying for."""
+        if not self.spec_live_cycles_total:
+            return 0.0
+        return self.spec_draft_depth_sum / self.spec_live_cycles_total
+
+    @property
+    def spec_acceptance_ema_mean(self) -> float:
+        """Mean per-sequence acceptance EMA over live sequences (0.0 when
+        the adaptive controller is off)."""
+        if self._spec_controller is None:
+            return 0.0
+        return self._spec_controller.mean_ema()
 
     def per_device_hbm_kv_bytes(self) -> Dict[str, int]:
         """Actual device bytes the KV pool (payload + scale sidecars)
@@ -894,7 +1042,8 @@ class ModelRunner:
                      win_k_in, win_v_in, counts0, prev_last, dparams,
                      spec_k, spec_v, spec_pos, *, b: int,
                      mb: int, num_steps: int, use_cached_window: bool,
-                     has_penalties: bool = False, logprobs_k: int = 0):
+                     has_penalties: bool = False, logprobs_k: int = 0,
+                     spec_on: bool = True):
         """One fused K-step decode dispatch.
 
         kv_ks/kv_vs: the per-(slot, head) dequant scale pools
@@ -956,11 +1105,15 @@ class ModelRunner:
         block_tables = packed[NUM_SCALARS * b:].reshape(b, mb)
         b_max = prev_last.shape[0]
 
-        if self.spec_n:
+        if self.spec_n and spec_on:
             # Speculative draft/verify cycles replace the one-token-per-
             # step scan entirely (docs/PERF.md round 8). Strict pipeline
             # ordering means rows never chain start tokens from an
-            # unapplied dispatch here.
+            # unapplied dispatch here. ``spec_on=False`` (adaptive
+            # controller, every row at gamma=0) compiles THIS non-spec
+            # body instead: the gamma=0 degradation is the plain scan
+            # with zero draft overhead, not a draft loop that drafts
+            # nothing (round 10; the dispatch-count-parity test pins it).
             return self._decode_spec(
                 params, dparams, kv_k, kv_v, kv_ks, kv_vs, win_k_in,
                 win_v_in, counts0, spec_k, spec_v, spec_pos, scalars,
@@ -1222,11 +1375,11 @@ class ModelRunner:
     @staticmethod
     def _spec_dummy_outs(spec_k, spec_v, spec_pos):
         """Trailing outputs of the non-speculative decode variant, shaped
-        to mirror the speculative one: per-cycle emit counts + draft/accept
-        counters (all unused dummies) and the draft pools passed through."""
-        z1 = jnp.zeros((1,), jnp.int32)
-        return (jnp.zeros((1, 1), jnp.int32), z1, z1, spec_k, spec_v,
-                spec_pos)
+        to mirror the speculative one: per-cycle emit counts + the [4, b]
+        per-row stats block (drafts/accepted/tree-nodes/live-cycles — all
+        unused dummies here) and the draft pools passed through."""
+        return (jnp.zeros((1, 1), jnp.int32), jnp.zeros((4, 1), jnp.int32),
+                spec_k, spec_v, spec_pos)
 
     def _decode_spec(self, params, dparams, kv_k, kv_v, kv_ks, kv_vs,
                      win_k_in, win_v_in, counts0, spec_k, spec_v, spec_pos,
@@ -1262,9 +1415,29 @@ class ModelRunner:
         budget is spent (at worst ``num_steps`` cycles — one emitted token
         per cycle at zero acceptance).
 
+        Round 10 adds two legs on the same cycle (both compile away to
+        the round-8 graph in fixed/linear mode):
+          * per-row draft DEPTH gamma (scalar row 13): the draft ring
+            writes and the accept gate honor each row's gamma, so a
+            low-acceptance row costs as little as the controller asks
+            (gamma=0 rows emit exactly one target token per cycle with
+            zero draft-ring traffic; the ALL-gamma=0 case never reaches
+            this function — _issue_decode dispatches spec_on=False).
+          * token-TREE verify (speculative_tree_width > 1): the verify
+            chunk carries n_spec + width nodes — the linear CRN chain
+            plus width-1 depth-1 alternates from the draft's own step-0
+            top-k — attended under a tree-ancestor attention bias
+            (ops/tree_mask.py) through the same window+ring+chunk
+            segments, still ONE target forward. The accept walk follows
+            the TARGET's samples down the tree (SpecInfer-style
+            topology, Leviathan-style deterministic acceptance), and a
+            path gather maps the accepted root-to-leaf path back to the
+            [b, N+1] layout every downstream commit path already uses.
+
         Returns the same tuple shape as the non-speculative variant, with
         toks_all = [K, N+1, b] per-cycle verify samples, emits = [K, b]
-        per-cycle emit counts, and per-row draft/accept counters.
+        per-cycle emit counts, and spec_stats = [4, b] per-row counters
+        (drafts, accepted, tree nodes, live cycles).
         """
         cfg = self.config
         mc = self.model_config
@@ -1289,6 +1462,12 @@ class ModelRunner:
         presence = jax.lax.bitcast_convert_type(scalars[9], jnp.float32)
         frequency = jax.lax.bitcast_convert_type(scalars[10], jnp.float32)
         slot_idx = scalars[12]
+        # Per-row draft depth (scalar row 13). The host packs n_spec for
+        # every row when the adaptive controller is off, which makes every
+        # gamma gate below a no-op — the fixed path stays bit-identical to
+        # round 8.
+        gamma = jnp.clip(scalars[13], 0, n_spec)
+        g_on = gamma > 0
         lora = (adapter_idx, self.lora_stacks) if self.lora_stacks else None
 
         if use_cached_window:
@@ -1315,7 +1494,13 @@ class ModelRunner:
         ones = jnp.ones((b,), jnp.int32)
         max_len = cfg.max_model_len
         d_max_pos = self._spec_draft_max_pos
-        full_lens = jnp.full((b,), n_spec + 1, jnp.int32)
+        tw = self.spec_tree_width
+        t_v = n_spec + tw          # verify-chunk nodes per row (tree adds
+        #                            tw-1 depth-1 alternates; tw=1 -> N+1)
+        full_lens = jnp.full((b,), t_v, jnp.int32)
+        if tw > 1:
+            tree_depths = jnp.asarray(self._spec_tree_depths)    # [t_v]
+            main_chain = jnp.asarray(self._spec_main_chain)      # [N+1]
 
         ring_k0 = jnp.zeros((nl, hkv, b, s_ring, dh), self.dtype)
         ring_v0 = jnp.zeros((nl, hkv, b, s_ring, dh), self.dtype)
@@ -1332,24 +1517,32 @@ class ModelRunner:
             apply_penalties,
             compute_logprobs,
             speculative_accept,
+            speculative_tree_accept,
         )
 
         def cycle(state):
             (j, toks, pos, gen_off, rem, base, ring_k, ring_v, ring_pos,
-             drk, drv, drp, counts, drafts, accepted, toks_buf, emit_buf,
-             lp_bufs) = state
+             drk, drv, drp, counts, drafts, accepted, tree_cnt, cycles,
+             toks_buf, emit_buf, lp_bufs) = state
             live = rem > 0
 
             # -- 1. draft N+1 autoregressive steps ----------------------
             def dstep(dc, i):
-                dtok, drk, drv, drp, props = dc
+                if tw > 1:
+                    dtok, drk, drv, drp, props, l1 = dc
+                else:
+                    dtok, drk, drv, drp, props = dc
                 dpos = pos + i
                 dpos_c = jnp.clip(dpos, 0, d_max_pos - 1)
                 hid, dk, dv = self._draft_forward(
                     dparams, dmc, dtok[:, None], dpos_c[:, None], ones,
                     None, None, None, drk, drv, drp,
                 )
-                widx = jnp.where(live, iota_b * r_len + dpos % r_len,
+                # gamma=0 rows draft nothing this dispatch: no ring
+                # writes (the forward itself is batched and unavoidable,
+                # but the row's draft state is untouched).
+                widx = jnp.where(live & g_on,
+                                 iota_b * r_len + dpos % r_len,
                                  b * r_len)
                 drk = drk.reshape(dnl, dhkv, b * r_len, ddh).at[
                     :, :, widx
@@ -1372,63 +1565,171 @@ class ModelRunner:
                     logits_d, temps, top_k, top_p, seeds_i
                 ).astype(jnp.int32)
                 props = props.at[i].set(prop)
+                if tw > 1:
+                    # Keep the STEP-0 draft SAMPLING scores (not raw
+                    # logits): the tree's depth-1 alternates must be the
+                    # runner-ups of the field the sampler argmaxes —
+                    # logits/T + Gumbel under the shared CRN seed — or
+                    # seeded-row divergences land outside the alternate
+                    # set and the tree never salvages anything. Carried,
+                    # not stacked: a [N+1, b, V] ys would be HBM waste.
+                    l1 = jnp.where(
+                        i == 0,
+                        sampling_scores(logits_d, temps, seeds_i),
+                        l1,
+                    )
+                    return (prop, drk, drv, drp, props, l1), None
                 return (prop, drk, drv, drp, props), None
 
             props0 = jnp.zeros((n_spec + 1, b), jnp.int32)
-            (_, drk, drv, drp, props), _ = jax.lax.scan(
-                dstep, (toks, drk, drv, drp, props0), iota_n1
-            )
+            if tw > 1:
+                l10 = jnp.zeros((b, self.model_config.vocab_size),
+                                jnp.float32)
+                (_, drk, drv, drp, props, l1), _ = jax.lax.scan(
+                    dstep, (toks, drk, drv, drp, props0, l10), iota_n1
+                )
+            else:
+                (_, drk, drv, drp, props), _ = jax.lax.scan(
+                    dstep, (toks, drk, drv, drp, props0), iota_n1
+                )
 
-            # -- 2. one batched target verify over [t0, q_0..q_{N-1}] ---
-            v_toks = jnp.concatenate(
-                [toks[:, None], props[:n_spec].T], axis=1
-            )                                               # [b, N+1]
-            v_pos = pos[:, None] + iota_n1[None, :]
+            # -- 2. one batched target verify ---------------------------
+            # Linear: the chunk is [t0, q_0..q_{N-1}] under plain causal
+            # attention. Tree: the chunk is the NODE list [t0, q_0,
+            # alt_1..alt_{tw-1}, q_1..q_{N-1}] — the linear chain plus
+            # the draft's top-(tw-1) step-0 alternates — attended under
+            # the tree-ancestor bias; node positions are pos + depth, so
+            # depth-1 siblings SHARE a position (and a seed: the CRN
+            # schedule is per generation index, not per node).
+            if tw > 1:
+                p1 = props[0]                               # [b]
+                alt_idx = jax.lax.top_k(
+                    l1.at[iota_b, p1].set(jnp.float32(-jnp.inf)), tw - 1
+                )[1].astype(jnp.int32)                      # [b, tw-1]
+                v_toks = jnp.concatenate(
+                    [toks[:, None], props[0][:, None], alt_idx,
+                     props[1:n_spec].T], axis=1,
+                )                                           # [b, T_v]
+                v_pos = pos[:, None] + tree_depths[None, :]
+                chunk_bias = self._spec_tree_bias
+                node_gen = tree_depths.astype(jnp.uint32)   # [T_v]
+            else:
+                v_toks = jnp.concatenate(
+                    [toks[:, None], props[:n_spec].T], axis=1
+                )                                           # [b, N+1]
+                v_pos = pos[:, None] + iota_n1[None, :]
+                chunk_bias = None
+                node_gen = iota_n1.astype(jnp.uint32)
             v_pos_c = jnp.minimum(v_pos, max_len - 1)
             hid, k_new, v_new = self._forward(
                 params, mc, v_toks, v_pos_c, full_lens,
                 win_k, win_v, win_len, ring_k, ring_v, ring_pos,
-                lora=lora,
+                lora=lora, chunk_bias=chunk_bias,
             )
-            logits = self._logits_fn(params, mc, hid)       # [b, N+1, V]
+            logits = self._logits_fn(params, mc, hid)       # [b, T_v, V]
             vocab = logits.shape[-1]
             seeds = (
                 seed_base[:, None] * _SEED_MULT
-                + (gen0[:, None] + gen_off[:, None]
-                   + iota_n1[None, :].astype(jnp.uint32))
-            ).astype(jnp.uint32)                            # [b, N+1]
+                + (gen0[:, None] + gen_off[:, None] + node_gen[None, :])
+            ).astype(jnp.uint32)                            # [b, T_v]
             if has_penalties:
-                # Sequential over positions: position i's penalties must
-                # include this cycle's earlier samples, exactly as the
-                # one-token-per-step scan would have counted them.
+                # Sequential over MAIN-CHAIN positions: position i's
+                # penalties must include this cycle's earlier samples,
+                # exactly as the one-token-per-step scan would have
+                # counted them. (tw=1: main chain == all positions.)
+                mci = main_chain if tw > 1 else iota_n1     # [N+1]
+                logits_m = logits[:, mci]
+                seeds_m = seeds[:, mci]
+
                 def vstep(c, i):
-                    cnt, z = c
+                    cnt, zm = c
                     eff = apply_penalties(
-                        logits[:, i], cnt, presence, frequency
+                        logits_m[:, i], cnt, presence, frequency
                     )
                     zi = sample_tokens(
-                        eff, temps, top_k, top_p, seeds[:, i]
+                        eff, temps, top_k, top_p, seeds_m[:, i]
                     ).astype(jnp.int32)
                     cnt = cnt.at[iota_b, zi].add(1)
-                    z = z.at[:, i].set(zi)
-                    return (cnt, z), None
+                    zm = zm.at[:, i].set(zi)
+                    return (cnt, zm), None
 
-                (_, z), _ = jax.lax.scan(
+                (_, z_main), _ = jax.lax.scan(
                     vstep, (counts, jnp.zeros((b, n_spec + 1), jnp.int32)),
                     iota_n1,
                 )
+                if tw > 1:
+                    # Alternate nodes sample EXACTLY what the linear
+                    # semantics would: conditioned on the walk reaching
+                    # alternate a, the depth-0 emission was v_toks[:, a]
+                    # itself, so that one count is the only penalty
+                    # delta vs. the pre-cycle counts.
+                    z = jnp.zeros((b, t_v), jnp.int32)
+                    z = z.at[:, mci].set(z_main)
+                    for a in range(2, tw + 1):
+                        cnt_a = counts.at[iota_b, v_toks[:, a]].add(1)
+                        eff_a = apply_penalties(
+                            logits[:, a], cnt_a, presence, frequency
+                        )
+                        za = sample_tokens(
+                            eff_a, temps, top_k, top_p, seeds[:, a]
+                        ).astype(jnp.int32)
+                        z = z.at[:, a].set(za)
+                else:
+                    z = z_main
             else:
                 z = sample_tokens(
-                    logits.reshape(b * (n_spec + 1), vocab),
-                    jnp.repeat(temps, n_spec + 1),
-                    jnp.repeat(top_k, n_spec + 1),
-                    jnp.repeat(top_p, n_spec + 1),
+                    logits.reshape(b * t_v, vocab),
+                    jnp.repeat(temps, t_v),
+                    jnp.repeat(top_k, t_v),
+                    jnp.repeat(top_p, t_v),
                     seeds.reshape(-1),
-                ).reshape(b, n_spec + 1).astype(jnp.int32)
+                ).reshape(b, t_v).astype(jnp.int32)
+
+            # -- 3. accept/emit -----------------------------------------
+            if tw > 1:
+                emit, acc, path_idx, main_len = speculative_tree_accept(
+                    v_toks, z, self._spec_tree_parents,
+                    self._spec_tree_depths, rem, gamma,
+                )
+                z_path = jnp.take_along_axis(z, path_idx, axis=1)
+                k_path = jnp.take_along_axis(
+                    k_new, path_idx[None, None, :, :, None], axis=3
+                )
+                v_path = jnp.take_along_axis(
+                    v_new, path_idx[None, None, :, :, None], axis=3
+                )
+            else:
+                emit, acc = speculative_accept(
+                    props[:n_spec].T, z, rem, gamma=gamma
+                )
+                path_idx = jnp.broadcast_to(
+                    iota_n1[None, :], (b, n_spec + 1)
+                )
+                main_len = emit
+                z_path, k_path, v_path = z, k_new, v_new
+            # Accepted-path positions are pos + step regardless of tree
+            # shape (the walk advances one depth per emitted token).
+            c_pos = pos[:, None] + iota_n1[None, :]          # [b, N+1]
+            valid_i = iota_n1[None, :] < emit[:, None]       # [b, N+1]
+            if has_penalties:
+                # Carry forward counts for EMITTED tokens only (the
+                # sequential vstep's temp counts included discarded tail
+                # positions).
+                zi_m = jnp.where(valid_i, z_path, vocab)     # OOB -> drop
+                counts = counts.at[
+                    jnp.broadcast_to(iota_b[:, None], (b, n_spec + 1)),
+                    zi_m,
+                ].add(1, mode="drop")
             if logprobs_k:
+                # Logprobs over the ACCEPTED path's nodes only (tw=1:
+                # path == chunk). Gathering logits first keeps the
+                # softmax at [b*(N+1), V] regardless of tree width.
+                logits_path = jnp.take_along_axis(
+                    logits, path_idx[:, :, None], axis=1
+                ) if tw > 1 else logits
                 lp = compute_logprobs(
-                    logits.reshape(b * (n_spec + 1), vocab),
-                    z.reshape(-1), logprobs_k,
+                    logits_path.reshape(b * (n_spec + 1), vocab),
+                    z_path.reshape(-1), logprobs_k,
                 )
                 lp_c = lp[0].reshape(b, n_spec + 1).T          # [N+1, b]
                 lp_t = lp[1].reshape(
@@ -1438,29 +1739,18 @@ class ModelRunner:
                     b, n_spec + 1, logprobs_k
                 ).transpose(1, 0, 2)
 
-            # -- 3. accept/emit -----------------------------------------
-            emit, acc = speculative_accept(props[:n_spec].T, z, rem)
-            valid_i = iota_n1[None, :] < emit[:, None]       # [b, N+1]
-            if has_penalties:
-                # Carry forward counts for EMITTED tokens only (the
-                # sequential vstep's temp counts included discarded tail
-                # positions).
-                zi_m = jnp.where(valid_i, z, vocab)          # OOB -> drop
-                counts = counts.at[
-                    jnp.broadcast_to(iota_b[:, None], (b, n_spec + 1)),
-                    zi_m,
-                ].add(1, mode="drop")
-
             # Commit valid target KV into the intra-dispatch ring at
             # [base, base+emit); rejected tail entries land at the drop
-            # index and are overwritten by the next cycle.
+            # index and are overwritten by the next cycle. Tree mode
+            # commits the PATH-gathered KV — the accepted root-to-leaf
+            # chain in [b, N+1] layout, exactly what linear mode commits.
             flat_r = jnp.where(
                 valid_i,
                 iota_b[:, None] * s_ring + base[:, None] + iota_n1[None, :],
                 b * s_ring,
             ).reshape(-1)
-            k_chunk = k_new.reshape(nl, hkv, b * (n_spec + 1), dh)
-            v_chunk = v_new.reshape(nl, hkv, b * (n_spec + 1), dh)
+            k_chunk = k_path.reshape(nl, hkv, b * (n_spec + 1), dh)
+            v_chunk = v_path.reshape(nl, hkv, b * (n_spec + 1), dh)
             ring_k = ring_k.reshape(nl, hkv, b * s_ring, dh).at[
                 :, :, flat_r
             ].set(k_chunk, mode="drop").reshape(nl, hkv, b, s_ring, dh)
@@ -1468,35 +1758,50 @@ class ModelRunner:
                 :, :, flat_r
             ].set(v_chunk, mode="drop").reshape(nl, hkv, b, s_ring, dh)
             ring_pos = ring_pos.reshape(-1).at[flat_r].set(
-                v_pos.reshape(-1), mode="drop"
+                c_pos.reshape(-1), mode="drop"
             ).reshape(b, s_ring)
 
-            # Draft-ring rollback: entries the draft wrote for rejected
-            # positions must never be attended (their input token was
-            # wrong); the sentinel masks them and the next cycle's draft
-            # rewrites the position with the corrected token.
-            inval = (~valid_i) & live[:, None]
+            # Draft-ring rollback: entries the draft wrote this cycle
+            # whose input token diverged from what the target emitted
+            # must never be attended; the sentinel masks them and the
+            # next cycle's draft rewrites the position with the
+            # corrected token. main_len counts the draft-ring entries
+            # that are still right: emit for linear acceptance, but only
+            # t0's entry when a tree walk salvaged a depth-1 SIBLING
+            # (the draft's chain continued from its own rejected q_0).
+            # gamma=0 rows wrote nothing, so nothing rolls back.
+            inval = (
+                (iota_n1[None, :] >= main_len[:, None])
+                & live[:, None] & g_on[:, None]
+            )
             rb_idx = jnp.where(
-                inval, iota_b[:, None] * r_len + v_pos % r_len, b * r_len
+                inval, iota_b[:, None] * r_len + c_pos % r_len, b * r_len
             ).reshape(-1)
             drp = drp.reshape(-1).at[rb_idx].set(
                 _POS_SENTINEL, mode="drop"
             ).reshape(b, r_len)
 
             new_tok = jnp.take_along_axis(
-                z, jnp.clip(emit - 1, 0, n_spec)[:, None], axis=1
+                z_path, jnp.clip(emit - 1, 0, n_spec)[:, None], axis=1
             )[:, 0]
             toks = jnp.where(emit > 0, new_tok, toks)
             pos = pos + emit
             gen_off = gen_off + emit.astype(jnp.uint32)
             base = base + emit
             rem = rem - emit
-            drafts = drafts + jnp.where(live, n_spec, 0)
+            drafts = drafts + jnp.where(live, gamma, 0)
             # Telemetry numerator is the PRE-budget-clip acceptance (the
             # draft's predictive quality — speculative_accept's contract);
             # emission may be clipped below it on a row's last tokens.
             accepted = accepted + jnp.where(live, acc, 0)
-            toks_buf = toks_buf.at[j].set(z.T)
+            # Tree nodes the verify pass considered for the row: the tw
+            # depth-1 nodes plus the gamma-1 deeper chain nodes (tw=1
+            # degrades to gamma — the linear chain itself).
+            tree_cnt = tree_cnt + jnp.where(
+                live & g_on, tw - 1 + gamma, 0
+            )
+            cycles = cycles + jnp.where(live, 1, 0)
+            toks_buf = toks_buf.at[j].set(z_path.T)
             emit_buf = emit_buf.at[j].set(emit)
             if logprobs_k:
                 lp_bufs = (
@@ -1506,20 +1811,22 @@ class ModelRunner:
                 )
             return (j + 1, toks, pos, gen_off, rem, base, ring_k, ring_v,
                     ring_pos, drk, drv, drp, counts, drafts, accepted,
-                    toks_buf, emit_buf, lp_bufs)
+                    tree_cnt, cycles, toks_buf, emit_buf, lp_bufs)
 
         zero_b = jnp.zeros((b,), jnp.int32)
         state0 = (
             jnp.int32(0), tokens0, pos0, jnp.zeros((b,), jnp.uint32),
             budget, zero_b, ring_k0, ring_v0, ring_pos0, drk0, drv0, drp0,
-            counts0, zero_b, zero_b, toks_buf0, emit_buf0, lp_bufs0,
+            counts0, zero_b, zero_b, zero_b, zero_b, toks_buf0, emit_buf0,
+            lp_bufs0,
         )
         final = jax.lax.while_loop(
             lambda st: (st[0] < k_cyc) & jnp.any(st[4] > 0),
             cycle, state0,
         )
         (_, final_toks, _, _, _, _, ring_k, ring_v, ring_pos, drk, drv,
-         drp, _, drafts, accepted, toks_buf, emit_buf, lp_bufs) = final
+         drp, _, drafts, accepted, tree_cnt, cycles, toks_buf, emit_buf,
+         lp_bufs) = final
 
         # ONE pool scatter for the whole dispatch, slots derived from the
         # committed ring positions (invalid entries -> reserved null
@@ -1556,9 +1863,10 @@ class ModelRunner:
         lp_c_buf, lp_t_buf, lp_i_buf = lp_bufs if logprobs_k else (
             None, None, None
         )
+        spec_stats = jnp.stack([drafts, accepted, tree_cnt, cycles])
         return (toks_buf, kv_k, kv_v, kv_ks, kv_vs, win_k, win_v,
                 lp_c_buf, lp_t_buf, lp_i_buf, last_token, emit_buf,
-                drafts, accepted, spec_k, spec_v, spec_pos)
+                spec_stats, spec_k, spec_v, spec_pos)
 
     def _issue_decode(self, batch: ScheduledBatch) -> "DispatchHandle":
         cfg = self.config
@@ -1582,17 +1890,43 @@ class ModelRunner:
             default=0,
         )
         sc[11, :] = -1
+        spec_on = True
+        gammas: Optional[List[int]] = None
         if self.spec_n:
             # Padding rows get an out-of-range slot: their scatter-back
             # drops instead of clobbering slot 0 (see _decode_spec).
             sc[12, :] = self.spec_num_slots
+            if self._spec_controller is not None:
+                gammas = [
+                    self._spec_controller.gamma(s.request_id) for s in seqs
+                ]
+                if not any(gammas):
+                    # Every row's controller says gamma=0: dispatch the
+                    # PLAIN decode body (spec_on=False static variant) —
+                    # no draft steps, no ring traffic, no slot churn.
+                    # This is the measured degradation bar: an all-cold
+                    # batch must cost exactly what spec-off costs.
+                    spec_on = False
+                    self.spec_gamma0_dispatches_total += 1
+            batch.spec_mode = (
+                "off-degrade" if not spec_on
+                else "adaptive" if self.spec_adaptive
+                else "tree" if self.spec_tree_width > 1
+                else "linear"
+            )
         chain_entry = None  # the ONE device vector this dispatch chains from
         for i, s in enumerate(seqs):
-            if self.spec_n:
-                # Disagg decode hops / restores join decode without a
-                # local prefill; give the draft its context first.
-                self._spec_catch_up(s, s.num_computed_tokens)
-                sc[12, i] = self.spec_slot(s.request_id)
+            if self.spec_n and spec_on:
+                g = gammas[i] if gammas is not None else self.spec_n
+                sc[13, i] = g
+                if g > 0:
+                    # Disagg decode hops / restores join decode without a
+                    # local prefill; give the draft its context first.
+                    # gamma=0 rows skip BOTH (no draft work this
+                    # dispatch; a later probe's catch-up replays the gap
+                    # from the warmed ledger).
+                    self._spec_catch_up(s, s.num_computed_tokens)
+                    sc[12, i] = self.spec_slot(s.request_id)
             pos = s.num_computed_tokens
             # Token chaining: a row whose last sampled token still sits in
             # an in-flight dispatch's device buffer (unapplied — the
@@ -1685,13 +2019,14 @@ class ModelRunner:
         kv_ks, kv_vs = self._scale_pool_args()
         dparams, sp_k, sp_v, sp_p = self._spec_pool_args()
         (toks_all, self.kv_k, self.kv_v, kv_ks2, kv_vs2, wk2, wv2, lp_c,
-         lp_t, lp_i, last_token, emits, drafts_cnt, accepted_cnt, sp_k2,
+         lp_t, lp_i, last_token, emits, spec_stats_dev, sp_k2,
          sp_v2, sp_p2) = self._decode(
             self.params, jnp.asarray(packed), self.kv_k, self.kv_v,
             kv_ks, kv_vs, wk, wv, jnp.asarray(counts), prev_last,
             dparams, sp_k, sp_v, sp_p,
             b=b, mb=mb, num_steps=k, use_cached_window=use_cached,
             has_penalties=has_penalties, logprobs_k=logprobs_k,
+            spec_on=spec_on,
         )
         self._rebind_scale_pools(kv_ks2, kv_vs2)
         self._rebind_spec_pools(sp_k2, sp_v2, sp_p2)
@@ -1707,7 +2042,8 @@ class ModelRunner:
                 # only after that fetch applies).
                 "end": [
                     seqs[i].num_computed_tokens
-                    + (0 if self.spec_n else batch.decode_steps[i])
+                    + (0 if (self.spec_n and spec_on)
+                       else batch.decode_steps[i])
                     for i in range(len(seqs))
                 ],
                 "win": (wk2, wv2),
@@ -1721,14 +2057,17 @@ class ModelRunner:
         steps = list(batch.decode_steps)
         n = len(seqs)
 
-        if self.spec_n:
+        if self.spec_n and spec_on:
             # Issue-time positions (advance_at_issue runs after this
             # call returns, so num_computed_tokens is still pos0 here).
             poss = [s.num_computed_tokens for s in seqs]
+            row_gammas = gammas if gammas is not None else [self.spec_n] * n
 
             def fetch():
                 out = np.asarray(toks_all)          # [K, N+1, b]
                 em = np.asarray(emits)              # [K, b]
+                stats = np.asarray(spec_stats_dev)  # [4, b]
+                drafts_cnt, accepted_cnt = stats[0], stats[1]
                 tokens = []
                 for i in range(n):
                     row = []
@@ -1737,18 +2076,34 @@ class ModelRunner:
                             int(out[c, t, i]) for t in range(em[c, i])
                         )
                     tokens.append(row)
-                    # Ring-warm ledger: the dispatch wrote draft KV for
-                    # every emitted token.
-                    self._spec_warmed[seqs[i].request_id] = \
-                        poss[i] + len(row)
+                    rid = seqs[i].request_id
+                    if row_gammas[i] > 0:
+                        # Ring-warm ledger: the dispatch wrote draft KV
+                        # for the emitted tokens. (Tree mode: a cycle
+                        # that salvaged a depth-1 SIBLING leaves that
+                        # one position's entry rolled back — an
+                        # acceptance-only pinhole the sentinel masks;
+                        # not worth a per-cycle host fetch to track.)
+                        # gamma=0 rows wrote nothing: their ledger
+                        # stays put so the next probe's catch-up
+                        # replays the gap.
+                        self._spec_warmed[rid] = poss[i] + len(row)
+                    if self._spec_controller is not None:
+                        self._spec_controller.update(
+                            rid, int(drafts_cnt[i]), int(accepted_cnt[i])
+                        )
                 # Acceptance telemetry accumulates at fetch (GIL-safe
                 # int adds; the engine loop serializes runner calls).
-                self.spec_draft_tokens_total += int(
-                    np.asarray(drafts_cnt).sum()
-                )
-                self.spec_accepted_tokens_total += int(
-                    np.asarray(accepted_cnt).sum()
-                )
+                d_tot = int(drafts_cnt.sum())
+                a_tot = int(accepted_cnt.sum())
+                self.spec_draft_tokens_total += d_tot
+                self.spec_accepted_tokens_total += a_tot
+                self._spec_window.append((d_tot, a_tot))
+                # stats row 0 is the sum of per-row gammas over live
+                # cycles — exactly the served-depth numerator.
+                self.spec_draft_depth_sum += d_tot
+                self.spec_tree_nodes_total += int(stats[2].sum())
+                self.spec_live_cycles_total += int(stats[3].sum())
                 if cache is not None and self._win_cache is cache:
                     for i in range(n):
                         cache["end"][i] += len(tokens[i])
@@ -2610,6 +2965,8 @@ class ModelRunner:
             "max_prefill_seqs": cfg.max_prefill_seqs,
             "spec": cfg.speculative_num_tokens,
             "spec_ring": self.spec_ring_len,
+            "spec_adaptive": cfg.speculative_adaptive,
+            "spec_tree": cfg.speculative_tree_width,
             "logprob_buckets": LOGPROB_BUCKETS,
             "decode_families": self.reachable_decode_families(),
             "prefill_families": self.reachable_prefill_families(),
@@ -2754,35 +3111,47 @@ class ModelRunner:
                 if warm_verified:
                     self.startup_deferred_families += len(dvariants) - 1
                     dvariants = variants[:1]
+                # The adaptive controller's all-gamma=0 degrade dispatches
+                # the spec_on=False static variant of every decode family
+                # — warm it too or the first cold batch pays a mid-serving
+                # compile (zero-compile-after-warmup contract).
+                spec_modes = (
+                    (True, False) if (self.spec_n and self.spec_adaptive)
+                    else (True,)
+                )
                 for pen, lpk in dvariants:
-                    if cached:
-                        wk, wv = wins[(db, mb)]
-                    else:
-                        wk = jnp.zeros((1, 1, 1, 1, 1), self.dtype)
-                        wv = jnp.zeros((1, 1, 1, 1, 1), self.dtype)
-                    counts = jnp.zeros(
-                        (db, mc.vocab_size) if pen else (1, 1), jnp.int32
-                    )
-                    kv_ks, kv_vs = self._scale_pool_args()
-                    dparams, sp_k, sp_v, sp_p = self._spec_pool_args()
-                    out = counted(
-                        self._decode,
-                        self.params,
-                        jnp.zeros((NUM_SCALARS * db + db * mb,), jnp.int32),
-                        self.kv_k, self.kv_v, kv_ks, kv_vs, wk, wv, counts,
-                        self._zero_last, dparams, sp_k, sp_v, sp_p,
-                        b=db, mb=mb, num_steps=dk,
-                        use_cached_window=cached,
-                        has_penalties=pen, logprobs_k=lpk,
-                    )
-                    _, self.kv_k, self.kv_v = out[0], out[1], out[2]
-                    self._rebind_scale_pools(out[3], out[4])
-                    self._rebind_spec_pools(out[14], out[15], out[16])
-                    if self.attn_impl != "paged":
-                        # Both variants return the (appended/gathered)
-                        # windows; the inputs were donated, so rebind.
-                        wins[(db, mb)] = (out[5], out[6])
-                    n_warmed += 1
+                    for sp_on in spec_modes:
+                        if cached:
+                            wk, wv = wins[(db, mb)]
+                        else:
+                            wk = jnp.zeros((1, 1, 1, 1, 1), self.dtype)
+                            wv = jnp.zeros((1, 1, 1, 1, 1), self.dtype)
+                        counts = jnp.zeros(
+                            (db, mc.vocab_size) if pen else (1, 1),
+                            jnp.int32
+                        )
+                        kv_ks, kv_vs = self._scale_pool_args()
+                        dparams, sp_k, sp_v, sp_p = self._spec_pool_args()
+                        out = counted(
+                            self._decode,
+                            self.params,
+                            jnp.zeros((NUM_SCALARS * db + db * mb,),
+                                      jnp.int32),
+                            self.kv_k, self.kv_v, kv_ks, kv_vs, wk, wv,
+                            counts, self._zero_last, dparams, sp_k, sp_v,
+                            sp_p, b=db, mb=mb, num_steps=dk,
+                            use_cached_window=cached,
+                            has_penalties=pen, logprobs_k=lpk,
+                            spec_on=sp_on,
+                        )
+                        _, self.kv_k, self.kv_v = out[0], out[1], out[2]
+                        self._rebind_scale_pools(out[3], out[4])
+                        self._rebind_spec_pools(out[13], out[14], out[15])
+                        if self.attn_impl != "paged":
+                            # Both variants return the (appended/gathered)
+                            # windows; the inputs were donated, so rebind.
+                            wins[(db, mb)] = (out[5], out[6])
+                        n_warmed += 1
             t_floor = prefill_t_floor(cfg.max_num_batched_tokens)
             for pb, t, mb, has_window in self.reachable_prefill_families():
                 # Coverage contract (mirrors the docstring): logprobs

@@ -21,6 +21,8 @@ for real LLM logits (vLLM's TPU backend makes the same tradeoff).
 from dataclasses import dataclass, field
 from typing import List, Optional
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
@@ -107,6 +109,8 @@ def speculative_accept(
     samples: jax.Array,      # [B, N+1] int32 — the target's own (seeded)
                              # samples at verify positions 0..N
     budget: jax.Array,       # [B] int32 — tokens the row may still emit
+    gamma: Optional[jax.Array] = None,  # [B] int32 — per-row draft depth
+                             # cap (adaptive control); None = all N
 ) -> tuple:
     """Deterministic accept/emit accounting for one draft/verify cycle
     (docs/PERF.md round 8). Proposal i is accepted iff it EQUALS the token
@@ -118,6 +122,12 @@ def speculative_accept(
     position (the "bonus" token, always emittable because verify scored
     position a's logits under a fully-accepted prefix).
 
+    ``gamma`` (round 10 adaptive control) caps how many proposals a row
+    may accept this cycle: proposals at index >= gamma[row] are treated as
+    mismatches. A gamma-0 row therefore always emits exactly the target's
+    own sample — depth control can never change WHAT is emitted, only how
+    much speculation paid for it.
+
     Returns (emit [B], accepted [B]):
       * emit     — tokens the row emits this cycle: min(accepted + 1,
                    budget); the emitted tokens are samples[:emit].
@@ -125,10 +135,114 @@ def speculative_accept(
       * accepted — draft proposals that survived (before budget clipping);
                    the telemetry numerator (acceptance = accepted / N).
     """
-    agree = (proposals == samples[:, :-1]).astype(jnp.int32)     # [B, N]
+    agree = proposals == samples[:, :-1]                         # [B, N]
+    if gamma is not None:
+        n = proposals.shape[1]
+        agree = agree & (
+            jnp.arange(n, dtype=jnp.int32)[None, :] < gamma[:, None]
+        )
+    agree = agree.astype(jnp.int32)
     accepted = jnp.cumprod(agree, axis=1).sum(axis=1)            # [B]
     emit = jnp.minimum(accepted + 1, jnp.maximum(budget, 0))
     return emit, accepted
+
+
+def speculative_tree_accept(
+    v_toks: jax.Array,       # [B, T] int32 — token at each tree node
+                             # (node 0 = the row's current token t0)
+    z: jax.Array,            # [B, T] int32 — the target's own (seeded)
+                             # sample AT each node, conditioned on the
+                             # node's ancestor path
+    parents,                 # [T] int (numpy/static) — tree_structure()
+    depths,                  # [T] int (numpy/static)
+    budget: jax.Array,       # [B] int32 — tokens the row may still emit
+    gamma: jax.Array,        # [B] int32 — per-row draft depth cap
+) -> tuple:
+    """Deterministic tree-accept walk (docs/PERF.md round 10; SpecInfer's
+    tree verification with the round-8 determinism contract). The walk
+    starts at the root and repeatedly emits the target's sample z[cur],
+    then steps to the child whose DRAFT token equals that sample (sibling
+    tokens are distinct by construction, so at most one child matches);
+    no matching child ends the walk — the last emitted sample is the
+    corrective "bonus" token. Every emitted token is therefore one of the
+    target's own samples along an accepted prefix: token-identical to
+    spec-off, exactly like the linear rule, but a first-position mismatch
+    can still salvage one draft token when a sibling branch matches.
+
+    ``parents``/``depths`` must be host-side (numpy) constants — the walk
+    unrolls over the static tree depth. Children at depth > gamma[row] are
+    never taken (adaptive depth control).
+
+    Returns (emit [B], accepted [B], path_idx [B, N+1], main_len [B]):
+      * emit     — tokens the row emits: min(walk length, budget); the
+                   emitted tokens are z gathered along path_idx[:emit].
+      * accepted — accepted draft tokens (walk length - 1, pre-clip) —
+                   the same telemetry numerator as the linear rule.
+      * path_idx — node index visited at each walk step (clamped to the
+                   last visited node once the walk ends); gathering z/KV
+                   along it restores the linear path's [B, N+1] shapes.
+      * main_len — valid DRAFT-RING entries after this cycle: the draft
+                   only wrote ring KV for the main chain [t0, p1..pN], so
+                   a walk that diverged onto a sibling branch keeps only
+                   the t0 entry (min'd with emit, like the linear rule).
+    """
+    b = v_toks.shape[0]
+    n_max = int(np.max(depths))          # main-chain draft depth N
+    par = jnp.asarray(np.asarray(parents, np.int32))
+    dep = jnp.asarray(np.asarray(depths, np.int32))
+    alive = budget > 0
+    cur = jnp.zeros((b,), jnp.int32)
+    emit_w = jnp.zeros((b,), jnp.int32)
+    first_child = jnp.zeros((b,), jnp.int32)
+    cols = []
+    for d in range(n_max + 1):
+        cols.append(cur)
+        emit_w = emit_w + alive.astype(jnp.int32)
+        if d == n_max:
+            break                        # deepest nodes have no children
+        zc = jnp.take_along_axis(z, cur[:, None], axis=1)[:, 0]
+        match = (
+            (par[None, :] == cur[:, None])
+            & (v_toks == zc[:, None])
+            & (dep[None, :] <= gamma[:, None])
+            & alive[:, None]
+        )
+        has = jnp.any(match, axis=1)
+        nxt = jnp.argmax(match, axis=1).astype(jnp.int32)
+        if d == 0:
+            first_child = jnp.where(has, nxt, 0)
+        cur = jnp.where(has, nxt, cur)
+        alive = alive & has
+    path_idx = jnp.stack(cols, axis=1)                  # [B, N+1]
+    accepted = jnp.maximum(emit_w - 1, 0)
+    emit = jnp.minimum(emit_w, jnp.maximum(budget, 0))
+    # Node 1 is the main chain's depth-1 node (ops/tree_mask.py layout);
+    # sibling branches have no children, so leaving the main chain at the
+    # first step is the only way off it.
+    main_acc = jnp.where(first_child == 1, accepted, 0)
+    main_len = jnp.minimum(main_acc + 1, emit)
+    return emit, accepted, path_idx, main_len
+
+
+def adaptive_gamma(alpha: float, n_max: int, threshold: float) -> int:
+    """Draft-depth policy for the adaptive controller (host-side, pure):
+    the largest g in [0, n_max] with alpha**g >= threshold — i.e. keep
+    deepening while the whole drafted prefix still survives verification
+    with probability at least ``threshold`` under the EMA acceptance
+    estimate alpha. threshold > 1 pins gamma to 0 (the spec-off
+    degradation configuration); alpha >= 1 saturates at n_max."""
+    if threshold > 1.0:
+        return 0
+    if alpha >= 1.0:
+        return n_max
+    if alpha <= 0.0:
+        return 0
+    g = 0
+    ev = 1.0
+    while g < n_max and ev * alpha >= threshold:
+        ev *= alpha
+        g += 1
+    return g
 
 
 def _gumbel(seeds: jax.Array, shape) -> jax.Array:
@@ -180,6 +294,26 @@ def sample_tokens(
     row_filtered = (top_k > 0) | (top_p < 1.0)
     sampled = jnp.where(row_filtered, filtered_pick, unfiltered_pick)
     return jnp.where(temperature <= 0.0, greedy, sampled)
+
+
+def sampling_scores(
+    logits: jax.Array,       # [B, V] float32
+    temperature: jax.Array,  # [B]
+    seeds: jax.Array,        # [B] uint32 per-row PRNG seeds
+) -> jax.Array:
+    """The score field whose argmax ``sample_tokens`` returns: raw logits
+    for greedy rows, ``logits/T + Gumbel(seed)`` for sampled rows. Rank-2
+    and below of THIS field are the tokens the target is most likely to
+    pick when its own logits diverge slightly from the caller's — the
+    right candidate pool for tree-speculation alternates under the common
+    random numbers seed schedule (raw-logit runner-ups are not: the
+    shared Gumbel perturbation reorders them).
+    """
+    greedy_scores = logits.astype(jnp.float32)
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    g = _gumbel(seeds, logits.shape)
+    perturbed = greedy_scores / temp + g
+    return jnp.where(temperature[:, None] <= 0.0, greedy_scores, perturbed)
 
 
 def compute_logprobs(

@@ -185,6 +185,13 @@ class ScheduledBatch:
     # prefill, which rows completed their prompt in this chunk.
     epochs: List[int] = field(default_factory=list)
     finals: List[bool] = field(default_factory=list)
+    # Set by the runner at decode issue (docs/PERF.md round 10): which
+    # speculative dispatch variant actually ran — "off" (speculation
+    # disabled), "linear", "tree", "adaptive", or "off-degrade" (adaptive
+    # controller sent the whole batch down the plain scan). Attribution
+    # for the flight recorder's decode_issue events; apply_results never
+    # reads it (variable-emission reconciliation is shape-driven).
+    spec_mode: str = "off"
 
     @property
     def num_tokens(self) -> int:

@@ -104,6 +104,7 @@ def _layer_body(
                               #  interpret, tp_mesh|None) + scan layer index
     lora=None,                # (adapter_idx [B], {target: (A, B)} ONE layer)
     ring_mesh=None,           # Mesh with sp>1: first-chunk prefill rings
+    chunk_bias=None,          # [T, T] additive in-chunk bias (tree verify)
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     b, t, d = hidden.shape
     h, hkv, dh = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
@@ -216,6 +217,7 @@ def _layer_body(
         attn = window_attention(
             q, k, v, positions, chunk_lens,
             win_k, win_v, win_len, ring_k, ring_v, ring_pos,
+            chunk_bias=chunk_bias,
         )
     hidden = hidden + proj(attn.reshape(b, t, h * dh), "wo")
 
@@ -247,6 +249,8 @@ def forward(
                  #  int8 pools, in-kernel dequantization)
     lora=None,   # (adapter_idx [B], {target: (A [L,Na,in,r], B [L,Na,r,out])})
     ring_mesh=None,  # Mesh with sp>1: first-chunk prefill uses ring attention
+    chunk_bias=None,  # [T, T] additive in-chunk bias — speculative token-tree
+                      # verify (ops/tree_mask.py); window path only
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Returns (hidden [B,T,D], k_new [L,Hkv,B,T,Dh], v_new [L,Hkv,B,T,Dh]).
 
@@ -295,6 +299,7 @@ def forward(
             cfg, h_carry, lp, cos, sin, positions, chunk_lens,
             wk, wv, win_len, rk, rv, ring_pos,
             paged=paged, layer_idx=li, lora=lo, ring_mesh=ring_mesh,
+            chunk_bias=chunk_bias,
         )
         return h_out, (k_l, v_l)
 

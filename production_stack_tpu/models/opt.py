@@ -54,7 +54,8 @@ def init_params(cfg: ModelConfig, rng: jax.Array, dtype=jnp.bfloat16) -> Params:
 
 
 def _layer_body(cfg, hidden, lp, positions, chunk_lens,
-                win_k, win_v, win_len, ring_k, ring_v, ring_pos):
+                win_k, win_v, win_len, ring_k, ring_v, ring_pos,
+                chunk_bias=None):
     b, t, d = hidden.shape
     h, dh = cfg.num_heads, cfg.head_dim_
 
@@ -66,6 +67,7 @@ def _layer_body(cfg, hidden, lp, positions, chunk_lens,
     attn = window_attention(
         q, k, v, positions, chunk_lens,
         win_k, win_v, win_len, ring_k, ring_v, ring_pos,
+        chunk_bias=chunk_bias,
     )
     hidden = hidden + attn.reshape(b, t, h * dh) @ lp["wo"] + lp["bo"]
 
@@ -93,6 +95,7 @@ def forward(
     paged=None,
     lora=None,
     ring_mesh=None,
+    chunk_bias=None,  # [T, T] additive in-chunk bias (tree verify)
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Same contract as models/llama.py:forward (see its docstring).
     The paged (Pallas flash-decode) path is llama-family-only BY POLICY
@@ -126,6 +129,7 @@ def forward(
         h_out, k_l, v_l = _layer_body(
             cfg, h_carry, lp, positions, chunk_lens,
             wk, wv, win_len, rk, rv, ring_pos,
+            chunk_bias=chunk_bias,
         )
         return h_out, (k_l, v_l)
 

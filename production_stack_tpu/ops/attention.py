@@ -67,6 +67,7 @@ def window_attention(
     ring_pos: Optional[jax.Array] = None,  # [B, R] position per entry
     *,
     scale: Optional[float] = None,
+    chunk_bias: Optional[jax.Array] = None,  # [T, T] additive f32 {0, -inf}
 ) -> jax.Array:
     """Dense attention against up to three key segments, TPU-shaped.
 
@@ -83,6 +84,14 @@ def window_attention(
         ring_pos < position; unwritten entries carry a sentinel position);
       * chunk  — the current tokens themselves, causal within the chunk
         (valid where position_key <= position_query and key_idx < chunk_len).
+
+    ``chunk_bias``: optional [T, T] additive f32 bias ADDED to the in-chunk
+    causal mask — the speculative token-tree segment (ops/tree_mask.py),
+    where sibling draft branches share a position and must not attend each
+    other. The bias is an exact AND with position-causality (tree ancestry
+    implies smaller depth, hence smaller position), shared across rows.
+    Only the single-Q-block path supports it (speculative verify chunks are
+    N+W <= 24 tokens, far under QBLOCK).
 
     Returns [B, T, H, Dh] in q.dtype.
     """
@@ -122,6 +131,10 @@ def window_attention(
             & (positions[:, None, :] <= pos_q[:, :, None]),
             0.0, neg,
         )                                                   # [B, TQ, T]
+        if chunk_bias is not None:
+            # Clamped add: both masks bottom out at _NEG_INF, and
+            # (-inf) + (-inf) would overflow the finite sentinel.
+            cb = jnp.maximum(cb + chunk_bias[None, :, :], neg)
         segs = []
         if win_k is not None:
             sw = _seg_scores(qb, win_k)
@@ -155,6 +168,8 @@ def window_attention(
     if t <= QBLOCK:
         out = q_block(qf, positions)
     else:
+        assert chunk_bias is None, \
+            "chunk_bias (tree speculation) requires t <= QBLOCK"
         assert t % QBLOCK == 0, "token bucket must be a multiple of QBLOCK"
         nb = t // QBLOCK
         qs = qf.reshape(hkv, b, g, nb, QBLOCK, dh).transpose(3, 0, 1, 2, 4, 5)

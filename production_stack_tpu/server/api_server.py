@@ -1389,6 +1389,8 @@ def build_engine_from_args(args: argparse.Namespace) -> ServingEngine:
         attn_impl=args.attn_impl,
         speculative_num_tokens=args.speculative_num_tokens,
         speculative_model=args.speculative_model,
+        speculative_adaptive=args.speculative_adaptive,
+        speculative_tree_width=args.speculative_tree_width,
         **({"speculative_draft_window": args.speculative_draft_window}
            if args.speculative_draft_window is not None else {}),
         enable_warmup=not args.no_warmup,
@@ -1500,6 +1502,21 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "full context, highest acceptance but ring memory "
                         "scales with max_model_len x slots; smaller "
                         "bounds draft memory at an acceptance-only cost)")
+    p.add_argument("--speculative-adaptive", action="store_true",
+                   help="per-sequence adaptive draft depth (docs/PERF.md "
+                        "round 10): an acceptance EMA picks each row's "
+                        "gamma every dispatch; rows that stop accepting "
+                        "shrink toward gamma=0, and an all-gamma=0 batch "
+                        "dispatches the plain non-speculative scan. "
+                        "Output stays token-identical; requires "
+                        "--speculative-num-tokens > 0")
+    p.add_argument("--speculative-tree-width", type=int, default=1,
+                   help="token-tree verify branching at the first draft "
+                        "position (docs/PERF.md round 10): the verify "
+                        "pass carries width-1 extra depth-1 alternates "
+                        "from the draft's own top-k, still in ONE target "
+                        "forward. 1 = linear speculation (default); "
+                        "requires --speculative-num-tokens > 0; max 8")
     p.add_argument("--lora-modules", nargs="*", default=[],
                    metavar="NAME=PATH",
                    help="LoRA adapters to serve (vLLM convention): "

@@ -29,6 +29,9 @@ def render_engine_metrics(engine: "ServingEngine", model_name: str) -> str:
                 "kv_chain_evictions_total", "resume_restored_tokens_total",
                 "spec_enabled", "spec_draft_tokens_total",
                 "spec_accepted_tokens_total", "spec_acceptance_rate",
+                "spec_acceptance_rate_window", "spec_draft_depth",
+                "spec_tree_nodes_total", "spec_acceptance_ema",
+                "spec_gamma0_dispatches_total",
                 "startup_weight_load_seconds", "startup_compile_seconds",
                 "startup_warmup_seconds", "startup_prewarm_seconds",
                 "startup_total_seconds", "startup_cache_hit_families",
@@ -138,6 +141,29 @@ def render_engine_metrics(engine: "ServingEngine", model_name: str) -> str:
         "# TYPE pstpu:spec_accepted_tokens_total counter",
         f"pstpu:spec_accepted_tokens_total{label} "
         f"{s['spec_accepted_tokens_total']}",
+        "# HELP pstpu:spec_acceptance_rate_window Draft acceptance over "
+        "the last <=64 dispatch fetches (windowed companion to the "
+        "lifetime rate)",
+        "# TYPE pstpu:spec_acceptance_rate_window gauge",
+        f"pstpu:spec_acceptance_rate_window{label} "
+        f"{s['spec_acceptance_rate_window']:.6f}",
+        "# HELP pstpu:spec_draft_depth Mean served draft depth per live "
+        "verify cycle (adaptive gamma controller)",
+        "# TYPE pstpu:spec_draft_depth gauge",
+        f"pstpu:spec_draft_depth{label} {s['spec_draft_depth']:.6f}",
+        "# HELP pstpu:spec_tree_nodes_total Token-tree nodes verified "
+        "(tree speculation)",
+        "# TYPE pstpu:spec_tree_nodes_total counter",
+        f"pstpu:spec_tree_nodes_total{label} {s['spec_tree_nodes_total']}",
+        "# HELP pstpu:spec_acceptance_ema Mean per-sequence acceptance "
+        "EMA over live sequences (adaptive controller)",
+        "# TYPE pstpu:spec_acceptance_ema gauge",
+        f"pstpu:spec_acceptance_ema{label} {s['spec_acceptance_ema']:.6f}",
+        "# HELP pstpu:spec_gamma0_dispatches_total Decode dispatches the "
+        "adaptive controller degraded to the plain (non-speculative) scan",
+        "# TYPE pstpu:spec_gamma0_dispatches_total counter",
+        f"pstpu:spec_gamma0_dispatches_total{label} "
+        f"{s['spec_gamma0_dispatches_total']}",
         "# HELP pstpu:spec_acceptance_rate Lifetime fraction of draft "
         "proposals accepted by the target",
         "# TYPE pstpu:spec_acceptance_rate gauge",

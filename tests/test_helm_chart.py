@@ -124,6 +124,8 @@ def test_speculative_tpu_config_renders_engine_flags():
                     "speculativeNumTokens": 3,
                     "speculativeModel": "facebook/opt-125m",
                     "speculativeDraftWindow": 512,
+                    "speculativeAdaptive": True,
+                    "speculativeTreeWidth": 3,
                 },
             }],
         },
@@ -137,6 +139,8 @@ def test_speculative_tpu_config_renders_engine_flags():
     assert args[args.index("--speculative-num-tokens") + 1] == "3"
     assert args[args.index("--speculative-model") + 1] == \
         "facebook/opt-125m"
+    assert "--speculative-adaptive" in args
+    assert args[args.index("--speculative-tree-width") + 1] == "3"
     from production_stack_tpu.server.api_server import (
         parse_args as engine_parse_args,
     )
@@ -145,6 +149,8 @@ def test_speculative_tpu_config_renders_engine_flags():
     assert ns.speculative_num_tokens == 3
     assert ns.speculative_model == "facebook/opt-125m"
     assert ns.speculative_draft_window == 512
+    assert ns.speculative_adaptive is True
+    assert ns.speculative_tree_width == 3
     # And the knobs satisfy the published schema.
     jsonschema = pytest.importorskip("jsonschema")
     import json
@@ -152,6 +158,22 @@ def test_speculative_tpu_config_renders_engine_flags():
     with open(os.path.join(CHART, "values.schema.json")) as f:
         schema = json.load(f)
     jsonschema.validate(values, schema)
+    # speculativeAdaptive: false is a boolean flag — it must render NO
+    # --speculative-adaptive arg (store_true flags take no value).
+    values["servingEngineSpec"]["modelSpec"][0]["tpuConfig"] = {
+        "speculativeNumTokens": 3,
+        "speculativeModel": "facebook/opt-125m",
+        "speculativeAdaptive": False,
+    }
+    manifests = render_chart(CHART, values=values, release_name="stack")
+    engine = next(
+        m for m in _by_kind(manifests, "Deployment")
+        if m["metadata"]["name"].endswith("deployment-engine")
+    )
+    args = [str(a) for a in _container(engine, "engine")["args"]]
+    assert "--speculative-adaptive" not in args
+    assert "--speculative-tree-width" not in args
+    assert engine_parse_args(args).speculative_adaptive is False
 
 
 def test_tensor_parallel_tpu_config_renders_engine_flag():

@@ -164,6 +164,25 @@ REGISTRY: Tuple[Series, ...] = (
     Series("pstpu:spec_acceptance_rate", "gauge", ("model_name",),
            _BOTH_ENGINE, ("catalogue", "speculative"),
            "Lifetime fraction of draft proposals accepted by the target"),
+    Series("pstpu:spec_acceptance_rate_window", "gauge", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "speculative"),
+           "Draft acceptance over the last <=64 dispatch fetches "
+           "(windowed companion to the lifetime rate)"),
+    Series("pstpu:spec_draft_depth", "gauge", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "speculative"),
+           "Mean served draft depth per live verify cycle (adaptive "
+           "gamma controller)"),
+    Series("pstpu:spec_tree_nodes_total", "counter", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "speculative"),
+           "Token-tree nodes verified (tree speculation)"),
+    Series("pstpu:spec_acceptance_ema", "gauge", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "speculative"),
+           "Mean per-sequence acceptance EMA over live sequences "
+           "(adaptive controller)"),
+    Series("pstpu:spec_gamma0_dispatches_total", "counter", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "speculative"),
+           "Decode dispatches the adaptive controller degraded to the "
+           "plain (non-speculative) scan"),
     # --------------------------------------------- engine: elastic fast-start
     Series("pstpu:startup_weight_load_seconds", "gauge", ("model_name",),
            _BOTH_ENGINE, ("catalogue", "elastic"),
