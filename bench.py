@@ -29,62 +29,19 @@ import os
 import sys
 import time
 
-# Peak HBM bandwidth of the benched chip (v5e ~819 GB/s; overridable when the
-# driver runs on different hardware).
-PEAK_HBM_GBS = float(os.environ.get("PSTPU_PEAK_HBM_GBS", 819.0))
+# Roofline math lives in the package so the engine can export its live
+# roofline position (pstpu:live_hbm_bw_pct) from the same arithmetic the
+# bench JSON line uses; re-exported here for the historical import path
+# (tests/test_kv_quant.py pins bench.roofline_components).
+from production_stack_tpu.perf.roofline import (  # noqa: F401,E402
+    HBM_PEAK_PRESETS_GBPS,
+    PEAK_HBM_GBS,
+    roofline_components,
+)
 
-
-def roofline_components(model: str, weight_dtype_bytes: float,
-                        kv_cache_dtype: str, batch: int, avg_ctx: float,
-                        peak_gbs: float = None,
-                        tokens_per_target_step: float = 1.0,
-                        num_chips: int = 1) -> dict:
-    """Aggregate decode roofline from the model's analytic byte counts —
-    WEIGHT bytes (compute dtype, amortized over the batch) split from KV
-    bytes (the KV-CACHE storage dtype + per-slot scale overhead, per row):
-    int8 KV halves the depth-dominant term, which is why the roofline
-    itself roughly doubles at long context. Pure function (unit-pinned by
-    tests/test_kv_quant.py).
-
-    ``tokens_per_target_step``: speculative decoding's effective emitted
-    tokens per target-model step (1 + acceptance_rate * N; docs/PERF.md
-    round 8). Each target step still streams the same weight+KV bytes,
-    but they amortize over that many emitted tokens, so the effective
-    tokens/sec ceiling scales by the factor (the draft model's own bytes
-    are deliberately excluded — the draft is sized to be negligible).
-
-    ``num_chips``: devices the serving mesh occupies (tp x sp x dp). The
-    aggregate HBM roofline scales with the chip count — each tp shard
-    streams 1/tp of the weights and 1/tp of the KV per step over its OWN
-    HBM, so the denominator's bytes-per-chip shrink by the chip count
-    (equivalently: peak bandwidth multiplies). Without this the
-    ``hbm_bw_pct`` of a tp>1 run would flatter itself against a
-    single-chip ceiling (docs/PERF.md round 9)."""
-    from production_stack_tpu.engine.config import EngineConfig
-    from production_stack_tpu.models.config import resolve_model_config
-
-    peak = PEAK_HBM_GBS if peak_gbs is None else peak_gbs
-    peak *= max(1, int(num_chips))
-    mc = resolve_model_config(model)
-    d, f, v = mc.hidden_size, mc.intermediate_size, mc.vocab_size
-    dh, h, hkv, nl = mc.head_dim_, mc.num_heads, mc.num_kv_heads, mc.num_layers
-    per_layer = d * (h * dh) + 2 * d * (hkv * dh) + (h * dh) * d + 3 * d * f
-    embed = v * d * (1 if mc.tie_word_embeddings else 2)
-    param_bytes = (nl * per_layer + embed) * weight_dtype_bytes
-    kv_bytes_per_token = EngineConfig(
-        kv_cache_dtype=kv_cache_dtype
-    ).kv_cache_bytes_per_token(mc)
-    step_bytes_per_row = param_bytes / batch + kv_bytes_per_token * avg_ctx
-    factor = max(1.0, float(tokens_per_target_step))
-    return {
-        "kv_cache_dtype": kv_cache_dtype,
-        "param_bytes": param_bytes,
-        "kv_bytes_per_token": kv_bytes_per_token,
-        "kv_bytes_per_step_per_row": kv_bytes_per_token * avg_ctx,
-        "tokens_per_target_step": factor,
-        "num_chips": max(1, int(num_chips)),
-        "roofline_tok_s": peak * 1e9 / step_bytes_per_row * factor,
-    }
+# Schema version of the one-line JSON benchmark record. Bump when a field
+# changes meaning; tools/perfwatch.py keys its tolerant loader on it.
+BENCH_SCHEMA_VERSION = 2
 
 
 # Byte-level fallback tokenizer yield: ~150 words of filler tokenize to
@@ -1060,6 +1017,14 @@ def main():
                     help="KV-cache storage dtype for the engines AND the "
                          "roofline's KV term (int8 halves decode KV bytes "
                          "— docs/PERF.md round 7)")
+    ap.add_argument("--hbm-peak-gbps", type=float,
+                    default=PEAK_HBM_GBS,
+                    help="peak HBM GB/s per chip for the roofline "
+                         "denominator (v5e 819, v5p 2765, v6e 1638 — "
+                         "docs/PERF.md presets; default "
+                         "$PSTPU_PEAK_HBM_GBS or the v5e preset). "
+                         "Recorded in the JSON line as hbm_peak_gbps so "
+                         "perfwatch only compares like-for-like rooflines")
     # Per-user seeded chat history (reference shape: 20k tokens — request
     # --history-tokens 20000 --max-model-len 32768; the default fits the
     # default 8192 context). Makes kv_hit_rate a measured quantity.
@@ -1364,18 +1329,22 @@ def _result_line(args, res) -> dict:
     engines = 2 if getattr(args, "disagg", False) \
         else max(1, getattr(args, "num_engines", 1))
     num_chips = tp * engines
+    hbm_peak = float(getattr(args, "hbm_peak_gbps", PEAK_HBM_GBS))
     comp = roofline_components(
         args.model, dtype_bytes, args.kv_cache_dtype, max(1, args.users),
-        avg_ctx, tokens_per_target_step=eff_tokens, num_chips=num_chips,
+        avg_ctx, peak_gbs=hbm_peak,
+        tokens_per_target_step=eff_tokens, num_chips=num_chips,
     )
     roofline = comp["roofline_tok_s"]
     out = {
+        "bench_schema_version": BENCH_SCHEMA_VERSION,
         "metric": res["metric"],
         "value": res["value"],
         "unit": "tok/s",
         "vs_baseline": round(res["value"] / roofline, 3),
         "roofline_tok_s": round(roofline, 1),
         "hbm_bw_pct": round(100 * res["value"] / roofline, 1),
+        "hbm_peak_gbps": hbm_peak,
         # Roofline byte components (satellite: the KV term follows the
         # KV-cache dtype; weights stay in the compute dtype).
         "kv_cache_dtype": args.kv_cache_dtype,

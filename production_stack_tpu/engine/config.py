@@ -222,8 +222,20 @@ class EngineConfig:
     # path — so this stays on by default; False removes the /debug surface
     # entirely (plain 404) and records nothing.
     debug_endpoints: bool = True
-    # Bounded ring: at most this many recent request timelines are kept.
+    # Bounded ring: at most this many recent request timelines are kept,
+    # each holding at most flight_recorder_max_events events (overflow is
+    # counted on the record, never silently lost).
     flight_recorder_capacity: int = 256
+    flight_recorder_max_events: int = 512
+    # Peak HBM GB/s per chip for the live roofline telemetry
+    # (pstpu:live_hbm_bw_pct): the denominator of the decode roofline the
+    # engine reports its own position against. Presets: v5e 819, v5p 2765,
+    # v6e 1638 (docs/PERF.md). Default follows bench.py's env override.
+    hbm_peak_gbps: float = field(
+        default_factory=lambda: float(
+            os.environ.get("PSTPU_PEAK_HBM_GBS", 819.0)
+        )
+    )
 
     def __post_init__(self):
         # Speculative decoding is validated at CONFIG PARSE TIME so a

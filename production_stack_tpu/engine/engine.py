@@ -196,8 +196,21 @@ class ServingEngine:
         self.overlapped_fetches_total = 0
         self.dispatch_gap_seconds_total = 0.0
         self._last_fetch_done: Optional[float] = None
+        # Live roofline telemetry (docs/OBSERVABILITY.md fleet pane): a
+        # rolling window of per-dispatch accounting tuples
+        # (fetch_done_mono, issue->fetch seconds, train kind, tokens
+        # emitted, target-model steps) appended at fetch from timestamps
+        # the loop already takes host-side — zero new device syncs. The
+        # pstpu:live_* gauges are derived from it on demand in stats().
+        self._dispatch_window: deque = deque(maxlen=256)
+        # Host-stall component of the pipeline bubble: fetch-done ->
+        # next issue-START gap (dispatch_gap_seconds_total measures to
+        # AFTER execute_async returns, so it folds compile time in; this
+        # one isolates the host's own scheduling stall).
+        self.host_stall_seconds_total = 0.0
         # telemetry
         from production_stack_tpu.engine.metrics import (
+            DispatchDurationHistograms,
             LifecycleHistograms,
             RequestLatencyHistograms,
         )
@@ -213,11 +226,15 @@ class ServingEngine:
             )
 
             self.recorder = FlightRecorder(
-                capacity=config.flight_recorder_capacity
+                capacity=config.flight_recorder_capacity,
+                max_events=config.flight_recorder_max_events,
             )
         # Per-phase latency histograms (always on — pure in-memory
         # observes): queue wait, prefill, decode trains, restores.
         self.lifecycle = LifecycleHistograms()
+        # Per-train issue->fetch duration histograms (prefill / decode /
+        # decode_spec), observed at fetch from the handle's issue stamp.
+        self.dispatch_hists = DispatchDurationHistograms()
         self.scheduler.on_preempt = self._on_preempt
         self.scheduler.on_restore = self._on_restore
         self.start_time = time.monotonic()
@@ -795,6 +812,23 @@ class ServingEngine:
                 batch, tokens, lps
             )
             self.generation_tokens_total += accepted
+            # Live roofline accounting (stats() folds the window into the
+            # pstpu:live_* gauges): all values below are host-side reads
+            # the loop already has — no device sync. target_steps counts
+            # the target model's scan steps a decode train ran, so
+            # emitted/target_steps is the Leviathan'23 amortization factor
+            # (>1 only when speculation pays).
+            train = ("prefill" if batch.kind != "decode"
+                     else "decode_spec" if batch.spec_mode != "off"
+                     else "decode")
+            duration = self._last_fetch_done - handle.issue_time
+            target_steps = (len(batch.seqs) * batch.num_steps
+                            if batch.kind == "decode" else 0)
+            self.dispatch_hists.observe(train, duration)
+            self._dispatch_window.append(
+                (self._last_fetch_done, duration, train, accepted,
+                 target_steps)
+            )
             for seq in produced:
                 self._process_output(seq)
             await self._publish_handoffs(produced)
@@ -857,6 +891,11 @@ class ServingEngine:
                 if not in_flight and self._last_fetch_done is not None:
                     self.dispatch_gap_seconds_total += (
                         time.monotonic() - self._last_fetch_done
+                    )
+                    # issue_mono predates execute_async, so this isolates
+                    # the host's own stall from any compile inside issue.
+                    self.host_stall_seconds_total += max(
+                        0.0, issue_mono - self._last_fetch_done
                     )
                 if batch.kind == "decode":
                     self.decode_dispatches_total += 1
@@ -1191,6 +1230,53 @@ class ServingEngine:
     def _offload_stat(self, attr: str) -> int:
         return getattr(self.offload, attr, 0) if self.offload else 0
 
+    def _live_perf(self) -> Dict[str, float]:
+        """Live roofline position from the rolling dispatch window
+        (docs/OBSERVABILITY.md fleet pane): throughput over the window's
+        wall span, the Leviathan'23 effective tokens per target-model
+        step, and achieved-vs-roofline HBM bandwidth — the same
+        arithmetic as bench.py's JSON line (shared
+        production_stack_tpu/perf/roofline.py), but computed continuously
+        against the CURRENT batch shape. Pure host-side dict math over
+        timestamps the loop already took; an idle engine reports zeros."""
+        out = {
+            "live_tok_per_s": 0.0,
+            "live_hbm_bw_pct": 0.0,
+            "live_effective_tokens_per_target_step": 0.0,
+        }
+        win = list(self._dispatch_window)
+        if not win:
+            return out
+        # Span from the oldest dispatch's ISSUE to the newest FETCH.
+        span = max(win[-1][0] - (win[0][0] - win[0][1]), 1e-9)
+        tok_s = sum(e[3] for e in win) / span
+        out["live_tok_per_s"] = tok_s
+        decode_steps = sum(e[4] for e in win)
+        eff = 1.0
+        if decode_steps:
+            eff = sum(e[3] for e in win if e[4]) / decode_steps
+            out["live_effective_tokens_per_target_step"] = eff
+        from production_stack_tpu.perf.roofline import roofline_components
+
+        running = self.scheduler.running
+        avg_ctx = (sum(s.num_tokens for s in running) / len(running)
+                   if running else 1.0)
+        dtype_bytes = {"bfloat16": 2.0, "float16": 2.0, "float32": 4.0}.get(
+            self.config.dtype, 2.0
+        )
+        try:
+            comp = roofline_components(
+                self.config.model, dtype_bytes, self.config.kv_cache_dtype,
+                max(1, len(running)), avg_ctx,
+                peak_gbs=self.config.hbm_peak_gbps,
+                tokens_per_target_step=max(1.0, eff),
+                num_chips=max(1, self.mesh.size),
+            )
+            out["live_hbm_bw_pct"] = 100.0 * tok_s / comp["roofline_tok_s"]
+        except Exception:  # noqa: BLE001 — unknown model alias: no ceiling
+            pass
+        return out
+
     def stats(self) -> Dict:
         disagg = self.disagg.stats() if self.disagg is not None else {
             "kv_handoffs_total": 0,
@@ -1296,4 +1382,7 @@ class ServingEngine:
                 if self.fetches_total else 0.0
             ),
             "dispatch_gap_seconds_total": self.dispatch_gap_seconds_total,
+            # Live roofline telemetry (docs/OBSERVABILITY.md fleet pane).
+            "host_stall_seconds_total": self.host_stall_seconds_total,
+            **self._live_perf(),
         }

@@ -200,6 +200,53 @@ class EngineMetricsCollector(Collector):
                       "Cumulative host-observed time with NO dispatch "
                       "outstanding between two dispatches (pipeline bubble)",
                       eng.dispatch_gap_seconds_total)
+        # Live roofline telemetry (docs/OBSERVABILITY.md fleet pane): the
+        # engine's own roofline position from the rolling dispatch window
+        # — the text renderer exports the same series (PL004-aligned,
+        # "fleet-perf" docs group).
+        live_fn = getattr(eng, "_live_perf", None)
+        live = live_fn() if callable(live_fn) else {}
+        yield gauge("pstpu:live_tok_per_s",
+                    "Generation throughput over the rolling dispatch "
+                    "window (tokens emitted / window wall span)",
+                    live.get("live_tok_per_s", 0.0))
+        yield gauge("pstpu:live_hbm_bw_pct",
+                    "Achieved fraction (percent) of the decode HBM "
+                    "roofline for the CURRENT batch shape "
+                    "(production_stack_tpu/perf/roofline.py)",
+                    live.get("live_hbm_bw_pct", 0.0))
+        yield gauge("pstpu:live_effective_tokens_per_target_step",
+                    "Tokens emitted per target-model step over the "
+                    "rolling window (the Leviathan'23 amortization "
+                    "factor; >1 only when speculation pays)",
+                    live.get("live_effective_tokens_per_target_step", 0.0))
+        yield counter("pstpu:host_stall_seconds_total",
+                      "Cumulative fetch-done to next issue-START gap with "
+                      "nothing outstanding on device (the host's own "
+                      "scheduling stall, compile time excluded)",
+                      getattr(eng, "host_stall_seconds_total", 0.0))
+        # Per-train dispatch duration histogram ({train=prefill|decode|
+        # decode_spec}) — the only engine family with a second live label.
+        dh = getattr(eng, "dispatch_hists", None)
+        dd = HistogramMetricFamily(
+            "pstpu:dispatch_duration_seconds",
+            "Issue-to-fetch duration of each dispatch by train kind",
+            labels=["model_name", "train"],
+        )
+        for train in ("prefill", "decode", "decode_spec"):
+            h = getattr(dh, "hists", {}).get(train) if dh is not None \
+                else None
+            if h is None:
+                dd.add_metric([eng.config.model_name, train],
+                              [("+Inf", 0)], 0.0)
+                continue
+            buckets, cum = [], 0
+            for bound, c in zip(h.buckets, h.counts):
+                cum += c
+                buckets.append((str(bound), cum))
+            buckets.append(("+Inf", h.count))
+            dd.add_metric([eng.config.model_name, train], buckets, h.sum)
+        yield dd
         # Request-lifecycle phase histograms (docs/OBSERVABILITY.md):
         # where a request's latency went — queue wait, prefill, per-train
         # decode cadence, shared-tier restore round trips. The text
@@ -380,6 +427,42 @@ PHASE_BUCKETS = (
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
     1.0, 2.5, 5.0, 10.0,
 )
+
+
+class DispatchDurationHistograms:
+    """Issue-to-fetch duration of every dispatch, split by train kind
+    (prefill chunk / plain fused decode / speculative decode) — the
+    per-train cadence view behind the pstpu:live_* gauges
+    (docs/OBSERVABILITY.md fleet pane). Observed at fetch from the
+    handle's issue stamp the loop already holds; pure in-memory."""
+
+    TRAINS = ("prefill", "decode", "decode_spec")
+
+    def __init__(self):
+        self.hists = {t: Histogram(PHASE_BUCKETS) for t in self.TRAINS}
+
+    def observe(self, train: str, value: float) -> None:
+        h = self.hists.get(train)
+        if h is not None:
+            h.observe(value)
+
+    def render(self, label: str) -> list:
+        """One exposition family: single HELP/TYPE header, one bucket
+        series per train label value."""
+        lines = [
+            "# HELP pstpu:dispatch_duration_seconds Issue-to-fetch "
+            "duration of each dispatch by train kind",
+            "# TYPE pstpu:dispatch_duration_seconds histogram",
+        ]
+        inner = label[1:-1]
+        sep = "," if inner else ""
+        for train in self.TRAINS:
+            tl = f'{{{inner}{sep}train="{train}"}}'
+            # Headers dropped: the family emits ONE header pair above.
+            lines.extend(self.hists[train].render(
+                "pstpu:dispatch_duration_seconds", "", tl,
+            )[2:])
+        return lines
 
 
 class LifecycleHistograms:

@@ -1,0 +1,1 @@
+"""Shared performance accounting (roofline math, live telemetry helpers)."""

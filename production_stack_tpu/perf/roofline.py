@@ -1,0 +1,83 @@
+"""Decode HBM-bandwidth roofline accounting, shared by bench.py and the
+live engine telemetry (docs/PERF.md).
+
+Each fused decode step streams every weight byte once (amortized over the
+whole batch) plus each row's live KV, so the AGGREGATE ceiling is
+``PEAK_BW / (param_bytes / batch + kv_bytes_per_token * avg_ctx)``
+tokens/sec — the honest denominator for a memory-bound batched decode
+(SURVEY.md §6). ``bench.py`` computes it post hoc for a run's JSON line;
+``ServingEngine.stats()`` computes it continuously against the rolling
+dispatch window so a TPU slice reports its own roofline position as
+``pstpu:live_hbm_bw_pct``.
+"""
+
+import os
+
+# Peak HBM bandwidth presets per accelerator generation, GB/s per chip
+# (public TPU spec sheets; the TPU-slice measurement campaign records
+# which preset a run used via the bench JSON line's ``hbm_peak_gbps``).
+HBM_PEAK_PRESETS_GBPS = {
+    "v5e": 819.0,
+    "v5p": 2765.0,
+    "v6e": 1638.0,
+}
+
+# Peak HBM bandwidth of the benched chip (v5e default; overridable via the
+# env var, `bench.py --hbm-peak-gbps`, or `EngineConfig.hbm_peak_gbps`
+# when the driver runs on different hardware).
+PEAK_HBM_GBS = float(
+    os.environ.get("PSTPU_PEAK_HBM_GBS", HBM_PEAK_PRESETS_GBPS["v5e"])
+)
+
+
+def roofline_components(model: str, weight_dtype_bytes: float,
+                        kv_cache_dtype: str, batch: int, avg_ctx: float,
+                        peak_gbs: float = None,
+                        tokens_per_target_step: float = 1.0,
+                        num_chips: int = 1) -> dict:
+    """Aggregate decode roofline from the model's analytic byte counts —
+    WEIGHT bytes (compute dtype, amortized over the batch) split from KV
+    bytes (the KV-CACHE storage dtype + per-slot scale overhead, per row):
+    int8 KV halves the depth-dominant term, which is why the roofline
+    itself roughly doubles at long context. Pure function (unit-pinned by
+    tests/test_kv_quant.py).
+
+    ``tokens_per_target_step``: speculative decoding's effective emitted
+    tokens per target-model step (1 + acceptance_rate * N; docs/PERF.md
+    round 8). Each target step still streams the same weight+KV bytes,
+    but they amortize over that many emitted tokens, so the effective
+    tokens/sec ceiling scales by the factor (the draft model's own bytes
+    are deliberately excluded — the draft is sized to be negligible).
+
+    ``num_chips``: devices the serving mesh occupies (tp x sp x dp). The
+    aggregate HBM roofline scales with the chip count — each tp shard
+    streams 1/tp of the weights and 1/tp of the KV per step over its OWN
+    HBM, so the denominator's bytes-per-chip shrink by the chip count
+    (equivalently: peak bandwidth multiplies). Without this the
+    ``hbm_bw_pct`` of a tp>1 run would flatter itself against a
+    single-chip ceiling (docs/PERF.md round 9)."""
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.models.config import resolve_model_config
+
+    peak = PEAK_HBM_GBS if peak_gbs is None else peak_gbs
+    peak *= max(1, int(num_chips))
+    mc = resolve_model_config(model)
+    d, f, v = mc.hidden_size, mc.intermediate_size, mc.vocab_size
+    dh, h, hkv, nl = mc.head_dim_, mc.num_heads, mc.num_kv_heads, mc.num_layers
+    per_layer = d * (h * dh) + 2 * d * (hkv * dh) + (h * dh) * d + 3 * d * f
+    embed = v * d * (1 if mc.tie_word_embeddings else 2)
+    param_bytes = (nl * per_layer + embed) * weight_dtype_bytes
+    kv_bytes_per_token = EngineConfig(
+        kv_cache_dtype=kv_cache_dtype
+    ).kv_cache_bytes_per_token(mc)
+    step_bytes_per_row = param_bytes / batch + kv_bytes_per_token * avg_ctx
+    factor = max(1.0, float(tokens_per_target_step))
+    return {
+        "kv_cache_dtype": kv_cache_dtype,
+        "param_bytes": param_bytes,
+        "kv_bytes_per_token": kv_bytes_per_token,
+        "kv_bytes_per_step_per_row": kv_bytes_per_token * avg_ctx,
+        "tokens_per_target_step": factor,
+        "num_chips": max(1, int(num_chips)),
+        "roofline_tok_s": peak * 1e9 / step_bytes_per_row * factor,
+    }

@@ -8,6 +8,8 @@ routers/main_router.py:
   * GET /health — aggregates discovery + scraper thread liveness and shows
     the live dynamic config (main_router.py:127-162)
   * GET /metrics — router-derived Prometheus series (metrics_router.py:38-78)
+  * GET /fleet — the fleet-perf pane: per-backend live roofline gauges,
+    breaker position, KV signals, ramp-in progress (docs/OBSERVABILITY.md)
   * /v1/files, /v1/batches — files/batch services (files_router.py,
     batches_router.py)
 
@@ -53,6 +55,7 @@ from production_stack_tpu.router.routing_logic import (
     DisaggRouter,
     get_routing_logic,
     initialize_routing_logic,
+    ramp_in_penalty,
 )
 from production_stack_tpu.router.service_discovery import (
     get_service_discovery,
@@ -147,6 +150,57 @@ async def handle_health(request: web.Request) -> web.Response:
 _autoscale_published: dict = {"server": set(), "role": set()}
 
 
+def _fleet_view(ramp_in_seconds: float) -> dict:
+    """One JSON-ready document aggregating the router's per-backend view:
+    live roofline gauges from the engine scrape plane, breaker position,
+    KV-tier signals, ramp-in progress, and disagg role — the fleet-perf
+    pane (docs/OBSERVABILITY.md). Served by GET /fleet and mirrored into
+    the router_fleet_* gauges on every /metrics render."""
+    engine_stats = get_engine_stats_scraper().get_engine_stats()
+    request_stats = get_request_stats_monitor().get_request_stats(time.time())
+    resilience = get_resilience()
+    tracker = get_slo_tracker()
+    backends = []
+    for ep in sorted(get_service_discovery().get_endpoint_info(),
+                     key=lambda e: e.url):
+        es = engine_stats.get(ep.url)
+        rs = request_stats.get(ep.url)
+        backends.append({
+            "url": ep.url,
+            "role": (getattr(ep, "role", "")
+                     or (es.role if es is not None else "") or "unified"),
+            "live_tok_per_s": es.live_tok_per_s if es is not None else 0.0,
+            "live_hbm_bw_pct": (es.live_hbm_bw_pct
+                                if es is not None else 0.0),
+            "live_effective_tokens_per_target_step": (
+                es.live_effective_tokens_per_target_step
+                if es is not None else 0.0),
+            "queue_depth": ((es.num_running_requests
+                             + es.num_queuing_requests)
+                            if es is not None else 0),
+            "kv_usage": es.gpu_cache_usage_perc if es is not None else 0.0,
+            "kv_hit_rate": (es.gpu_prefix_cache_hit_rate
+                            if es is not None else 0.0),
+            "breaker_state": (resilience.state(ep.url)
+                              if resilience is not None else 0),
+            "ramp_in_penalty": ramp_in_penalty(ep, ramp_in_seconds),
+            "qps": rs.qps if rs is not None else 0.0,
+            "scraped": es is not None,
+        })
+    return {
+        "backends": backends,
+        "backends_total": len(backends),
+        "breakers": resilience.snapshot() if resilience is not None else {},
+        "slo_attainment": tracker.snapshot() if tracker is not None else {},
+    }
+
+
+async def handle_fleet(request: web.Request) -> web.Response:
+    return web.json_response(
+        _fleet_view(request.app.get("ramp_in_seconds", 0.0))
+    )
+
+
 async def handle_metrics(request: web.Request) -> web.Response:
     from prometheus_client import generate_latest, CONTENT_TYPE_LATEST
 
@@ -215,6 +269,22 @@ async def handle_metrics(request: web.Request) -> web.Response:
         metrics.router_pool_utilization.labels(role=role).set(
             pool_depth[role] / size
         )
+    # Fleet-perf pane (docs/OBSERVABILITY.md): mirror the /fleet aggregate
+    # into the router_fleet_* gauges so the Grafana fleet row charts the
+    # same numbers the JSON endpoint serves.
+    fleet = _fleet_view(request.app.get("ramp_in_seconds", 0.0))
+    metrics.router_fleet_backends.set(fleet["backends_total"])
+    for b in fleet["backends"]:
+        metrics.router_fleet_live_tok_per_s.labels(server=b["url"]).set(
+            b["live_tok_per_s"])
+        metrics.router_fleet_live_hbm_bw_pct.labels(server=b["url"]).set(
+            b["live_hbm_bw_pct"])
+        metrics.router_fleet_live_effective_tokens_per_target_step.labels(
+            server=b["url"]).set(b["live_effective_tokens_per_target_step"])
+        metrics.router_fleet_breaker_open.labels(server=b["url"]).set(
+            b["breaker_state"])
+        metrics.router_fleet_ramp_in_penalty.labels(server=b["url"]).set(
+            b["ramp_in_penalty"])
     # Departed backends/roles must DROP their autoscaler series, not
     # freeze at their last value: the HPA sums these (prom-adapter rule),
     # so a dead pod's stale depth would inflate the scale signal forever.
@@ -222,7 +292,12 @@ async def handle_metrics(request: web.Request) -> web.Response:
     for gone in _autoscale_published["server"] - live_servers:
         for gauge in (metrics.router_queue_depth, metrics.router_kv_pressure,
                       metrics.router_backend_kv_hit_rate,
-                      metrics.router_prefix_index_entries):
+                      metrics.router_prefix_index_entries,
+                      metrics.router_fleet_live_tok_per_s,
+                      metrics.router_fleet_live_hbm_bw_pct,
+                      metrics.router_fleet_live_effective_tokens_per_target_step,
+                      metrics.router_fleet_breaker_open,
+                      metrics.router_fleet_ramp_in_penalty):
             try:
                 gauge.remove(gone)
             except KeyError:
@@ -403,6 +478,9 @@ def initialize_all(app: web.Application, args) -> None:
         ramp_in_seconds=getattr(args, "ramp_in_seconds", 0.0),
         **routing_kwargs,
     )
+    # The fleet pane (GET /fleet, router_fleet_ramp_in_penalty) reports
+    # ramp-in progress against the same window the routing logic uses.
+    app["ramp_in_seconds"] = getattr(args, "ramp_in_seconds", 0.0)
     # Replica identity BEFORE the breaker registry exists, so every
     # breaker's first publish already carries the router label.
     import socket as _socket
@@ -548,6 +626,7 @@ def build_app(args) -> web.Application:
     app.router.add_post("/v1/rerank", handle_rerank)
     app.router.add_get("/v1/models", handle_models)
     app.router.add_get("/health", handle_health)
+    app.router.add_get("/fleet", handle_fleet)
     app.router.add_get("/metrics", handle_metrics)
     app.router.add_post("/v1/files", handle_file_upload)
     app.router.add_get("/v1/files/{file_id}", handle_file_get)

@@ -159,6 +159,40 @@ router_prefix_index_entries = Gauge(
     "Entries in the backend's last scraped /prefix_index digest "
     "(prefix-aware routing's view of device residency)", ["server"],
 )
+# Fleet performance pane (docs/OBSERVABILITY.md): the router's aggregate
+# view of the engines' live roofline gauges plus the router-side per-backend
+# state GET /fleet serves as JSON. Refreshed by the /metrics handler from
+# the scrape plane; departed backends drop their label series (same GC as
+# the autoscaler gauges).
+router_fleet_backends = Gauge(
+    "router_fleet_backends",
+    "Backends in the router's current fleet view (healthy serving "
+    "endpoints)", [],
+)
+router_fleet_live_tok_per_s = Gauge(
+    "router_fleet_live_tok_per_s",
+    "Engine-reported live generation throughput per backend", ["server"],
+)
+router_fleet_live_hbm_bw_pct = Gauge(
+    "router_fleet_live_hbm_bw_pct",
+    "Engine-reported live roofline position per backend (percent of the "
+    "decode HBM ceiling)", ["server"],
+)
+router_fleet_live_effective_tokens_per_target_step = Gauge(
+    "router_fleet_live_effective_tokens_per_target_step",
+    "Engine-reported tokens emitted per target-model step per backend "
+    "(speculation amortization)", ["server"],
+)
+router_fleet_breaker_open = Gauge(
+    "router_fleet_breaker_open",
+    "Circuit-breaker position per backend (0 closed / 1 open / 2 half-open) "
+    "in the fleet view", ["server"],
+)
+router_fleet_ramp_in_penalty = Gauge(
+    "router_fleet_ramp_in_penalty",
+    "Remaining ramp-in load penalty per backend (1 just joined -> 0 fully "
+    "ramped)", ["server"],
+)
 # Prefill/decode disaggregation (docs/DISAGG.md): two-hop flow outcomes.
 router_disagg_handoffs_total = Counter(
     "router_disagg_handoffs",

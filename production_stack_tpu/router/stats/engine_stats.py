@@ -34,6 +34,12 @@ class EngineStats:
     # Disagg role scraped from pstpu:disagg_role{role="..."} — the
     # DisaggRouter's pool-split fallback when discovery carries no role.
     role: str = ""
+    # Fleet-perf plane (docs/OBSERVABILITY.md): the engine's live roofline
+    # gauges, re-exported per backend as router_fleet_* and served by
+    # GET /fleet. 0.0 when the engine predates them (or is idle).
+    live_tok_per_s: float = 0.0
+    live_hbm_bw_pct: float = 0.0
+    live_effective_tokens_per_target_step: float = 0.0
 
     @staticmethod
     def from_prometheus_text(text: str, prev: Optional[Tuple[float, float]] = None):
@@ -74,6 +80,10 @@ class EngineStats:
             gpu_cache_usage_perc=values.get("vllm:gpu_cache_usage_perc", 0.0),
             num_preemptions=int(values.get("vllm:num_preemptions_total", 0)),
             role=role,
+            live_tok_per_s=values.get("pstpu:live_tok_per_s", 0.0),
+            live_hbm_bw_pct=values.get("pstpu:live_hbm_bw_pct", 0.0),
+            live_effective_tokens_per_target_step=values.get(
+                "pstpu:live_effective_tokens_per_target_step", 0.0),
         )
         return stats, (hits, queries)
 

@@ -1404,6 +1404,14 @@ def build_engine_from_args(args: argparse.Namespace) -> ServingEngine:
         **({"kv_remote_url": args.kv_remote_url}
            if args.kv_remote_url else {}),
         debug_endpoints=not args.no_debug_endpoints,
+        **({"hbm_peak_gbps": args.hbm_peak_gbps}
+           if getattr(args, "hbm_peak_gbps", None) is not None else {}),
+        **({"flight_recorder_capacity": args.flight_recorder_capacity}
+           if getattr(args, "flight_recorder_capacity", None) is not None
+           else {}),
+        **({"flight_recorder_max_events": args.flight_recorder_max_events}
+           if getattr(args, "flight_recorder_max_events", None) is not None
+           else {}),
     )
     return ServingEngine(cfg)
 
@@ -1544,6 +1552,19 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="shed new generation requests with 503 + "
                         "Retry-After while the wait queue is at least this "
                         "deep (0 disables)")
+    p.add_argument("--hbm-peak-gbps", type=float, default=None,
+                   help="per-chip peak HBM bandwidth in GB/s for the live "
+                        "roofline gauges (pstpu:live_hbm_bw_pct): v5e 819, "
+                        "v5p 2765, v6e 1638 (default: EngineConfig value, "
+                        "$PSTPU_PEAK_HBM_GBS or the v5e preset)")
+    p.add_argument("--flight-recorder-capacity", type=int, default=None,
+                   help="flight-recorder ring size in request records "
+                        "(default: EngineConfig tuned value, 256; "
+                        "docs/OBSERVABILITY.md)")
+    p.add_argument("--flight-recorder-max-events", type=int, default=None,
+                   help="max events kept per flight record before overflow "
+                        "counting starts (default: EngineConfig tuned "
+                        "value, 512)")
     p.add_argument("--no-debug-endpoints", action="store_true",
                    help="disable the /debug observability surface "
                         "(per-request flight-recorder timelines at "

@@ -36,7 +36,10 @@ def render_engine_metrics(engine: "ServingEngine", model_name: str) -> str:
                 "startup_warmup_seconds", "startup_prewarm_seconds",
                 "startup_total_seconds", "startup_cache_hit_families",
                 "startup_cache_miss_families",
-                "trace_spans_dropped_total"):
+                "trace_spans_dropped_total",
+                "host_stall_seconds_total", "live_tok_per_s",
+                "live_hbm_bw_pct",
+                "live_effective_tokens_per_target_step"):
         s.setdefault(key, 0)
     s.setdefault("disagg_role", "unified")
     s.setdefault("kv_cache_dtype", "bfloat16")
@@ -228,6 +231,30 @@ def render_engine_metrics(engine: "ServingEngine", model_name: str) -> str:
         "# TYPE pstpu:dispatch_gap_seconds_total counter",
         f"pstpu:dispatch_gap_seconds_total{label} "
         f"{s['dispatch_gap_seconds_total']:.6f}",
+        # Live roofline telemetry (docs/OBSERVABILITY.md fleet pane): the
+        # engine's own roofline position from the rolling dispatch window
+        # (the collector renders the same four series + the per-train
+        # dispatch-duration histogram below — PL004 "fleet-perf" group).
+        "# HELP pstpu:live_tok_per_s Generation throughput over the "
+        "rolling dispatch window (tokens emitted / window wall span)",
+        "# TYPE pstpu:live_tok_per_s gauge",
+        f"pstpu:live_tok_per_s{label} {s['live_tok_per_s']:.6f}",
+        "# HELP pstpu:live_hbm_bw_pct Achieved fraction (percent) of the "
+        "decode HBM roofline for the CURRENT batch shape",
+        "# TYPE pstpu:live_hbm_bw_pct gauge",
+        f"pstpu:live_hbm_bw_pct{label} {s['live_hbm_bw_pct']:.6f}",
+        "# HELP pstpu:live_effective_tokens_per_target_step Tokens emitted "
+        "per target-model step over the rolling window (>1 only when "
+        "speculation pays)",
+        "# TYPE pstpu:live_effective_tokens_per_target_step gauge",
+        f"pstpu:live_effective_tokens_per_target_step{label} "
+        f"{s['live_effective_tokens_per_target_step']:.6f}",
+        "# HELP pstpu:host_stall_seconds_total Fetch-done to next "
+        "issue-start gap with nothing outstanding on device (host "
+        "scheduling stall)",
+        "# TYPE pstpu:host_stall_seconds_total counter",
+        f"pstpu:host_stall_seconds_total{label} "
+        f"{s['host_stall_seconds_total']:.6f}",
         # Observability plane (docs/OBSERVABILITY.md): OTLP spans the
         # exporter queue had to drop — tracing never blocks serving, but
         # never silently either (the collector renders the same series;
@@ -312,4 +339,9 @@ def render_engine_metrics(engine: "ServingEngine", model_name: str) -> str:
     lifecycle = getattr(engine, "lifecycle", None)
     if lifecycle is not None:
         lines += lifecycle.render(label)
+    # Per-train dispatch-duration histogram (fleet-perf group): one
+    # family, {train=prefill|decode|decode_spec} series.
+    dispatch_hists = getattr(engine, "dispatch_hists", None)
+    if dispatch_hists is not None:
+        lines += dispatch_hists.render(label)
     return "\n".join(lines) + "\n"

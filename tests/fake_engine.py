@@ -65,6 +65,12 @@ class FakeEngine:
         self.prefix_hits = 0
         self.prefix_queries = 0
         self.kv_usage = 0.0
+        # Fleet-perf plane (docs/OBSERVABILITY.md): the live roofline
+        # gauges real engines export; tests inject values to drive the
+        # router's /fleet pane and router_fleet_* re-exports.
+        self.live_tok_per_s = 0.0
+        self.live_hbm_bw_pct = 0.0
+        self.live_eff_tokens = 0.0
         # /prefix_index digest (docs/KV_ECONOMY.md): tests inject truncated
         # block hashes here to simulate device prefix residency.
         self.prefix_index_entries = []
@@ -208,6 +214,9 @@ class FakeEngine:
             f'vllm:gpu_prefix_cache_hits_total{{model_name="{self.model}"}} {self.prefix_hits}\n'
             f'vllm:gpu_prefix_cache_queries_total{{model_name="{self.model}"}} {self.prefix_queries}\n'
             f'vllm:gpu_cache_usage_perc{{model_name="{self.model}"}} {self.kv_usage}\n'
+            f'pstpu:live_tok_per_s{{model_name="{self.model}"}} {self.live_tok_per_s}\n'
+            f'pstpu:live_hbm_bw_pct{{model_name="{self.model}"}} {self.live_hbm_bw_pct}\n'
+            f'pstpu:live_effective_tokens_per_target_step{{model_name="{self.model}"}} {self.live_eff_tokens}\n'
         )
         return web.Response(text=text, content_type="text/plain")
 

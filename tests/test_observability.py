@@ -28,6 +28,7 @@ def _exported_series():
         prefix_queries_total = 7
 
     from production_stack_tpu.engine.metrics import (
+        DispatchDurationHistograms,
         LifecycleHistograms,
         RequestLatencyHistograms,
     )
@@ -39,6 +40,7 @@ def _exported_series():
         generation_tokens_total = 20
         histograms = RequestLatencyHistograms()
         lifecycle = LifecycleHistograms()
+        dispatch_hists = DispatchDurationHistograms()
 
         def stats(self):
             return {
@@ -107,6 +109,22 @@ def test_dashboard_queries_name_exported_series():
     lifecycle_titles = [p["title"] for p in dash["panels"]
                         if p["title"].startswith("Request lifecycle")]
     assert len(lifecycle_titles) >= 3, lifecycle_titles
+    # Fleet-performance row (docs/OBSERVABILITY.md): the live roofline
+    # gauges and the router's fleet aggregate are charted, not just
+    # exported.
+    assert {"pstpu:live_tok_per_s",
+            "pstpu:live_hbm_bw_pct",
+            "pstpu:live_effective_tokens_per_target_step",
+            "pstpu:dispatch_duration_seconds_bucket",
+            "pstpu:host_stall_seconds_total",
+            "router_fleet_live_tok_per_s",
+            "router_fleet_live_hbm_bw_pct",
+            "router_fleet_breaker_open",
+            "router_fleet_ramp_in_penalty",
+            "router_fleet_backends"} <= all_series
+    fleet_titles = [p["title"] for p in dash["panels"]
+                    if p["title"].startswith("Fleet performance")]
+    assert len(fleet_titles) >= 3, fleet_titles
 
 
 def test_prom_adapter_rule_names_exported_series():
@@ -130,6 +148,10 @@ def test_prom_adapter_rule_names_exported_series():
     # per-backend hit rate and the shared-tier hit counter.
     assert {"router_backend_kv_hit_rate",
             "pstpu_kv_shared_tier_hits_total"} <= served
+    # Fleet-performance rules (docs/OBSERVABILITY.md): delivered tokens/s
+    # and roofline position as autoscaler-consumable Object metrics.
+    assert {"router_fleet_live_tok_per_s",
+            "router_fleet_live_hbm_bw_pct"} <= served
 
 
 def test_latency_histograms_scrape():

@@ -176,6 +176,49 @@ async def test_health_and_metrics_endpoints():
         await _stop_stack(servers, client)
 
 
+async def test_fleet_endpoint_aggregates_backend_view():
+    """GET /fleet serves one JSON document per backend — live roofline
+    gauges from the scrape plane, breaker position, KV signals, ramp-in —
+    and /metrics re-exports the same aggregate as router_fleet_* gauges
+    (docs/OBSERVABILITY.md fleet pane)."""
+    engines, servers, urls, client = await _start_stack(n_engines=2)
+    try:
+        engines[0].live_tok_per_s = 1234.5
+        engines[0].live_hbm_bw_pct = 61.25
+        engines[0].live_eff_tokens = 1.75
+        engines[0].kv_usage = 0.4
+        # Wait for a scrape pass (interval=1s).
+        await asyncio.sleep(1.5)
+        resp = await client.get("/fleet")
+        assert resp.status == 200
+        doc = await resp.json()
+        assert doc["backends_total"] == 2
+        assert len(doc["backends"]) == 2
+        by_url = {b["url"]: b for b in doc["backends"]}
+        b0 = by_url[urls[0]]
+        assert b0["live_tok_per_s"] == 1234.5
+        assert b0["live_hbm_bw_pct"] == 61.25
+        assert b0["live_effective_tokens_per_target_step"] == 1.75
+        assert b0["kv_usage"] == 0.4
+        assert b0["breaker_state"] == 0      # closed
+        assert b0["role"] == "unified"
+        assert b0["scraped"] is True
+        assert 0.0 <= b0["ramp_in_penalty"] <= 1.0
+        assert isinstance(doc["breakers"], dict)
+        assert isinstance(doc["slo_attainment"], dict)
+
+        # The /metrics render mirrors the same aggregate.
+        resp = await client.get("/metrics")
+        text = await resp.text()
+        assert "router_fleet_backends 2.0" in text
+        assert (f'router_fleet_live_tok_per_s{{server="{urls[0]}"}} 1234.5'
+                in text)
+        assert (f'router_fleet_breaker_open{{server="{urls[0]}"}} 0.0'
+                in text)
+    finally:
+        await _stop_stack(servers, client)
+
+
 async def test_request_id_forwarded_end_to_end():
     """The client's x-request-id reaches the BACKEND (router<->engine log
     correlation) and is echoed back to the client."""

@@ -1,0 +1,528 @@
+"""perfwatch: the performance-trajectory sentinel (docs/OBSERVABILITY.md).
+
+The repo's perf history lives in checked-in round artifacts — BENCH_r01..r10,
+BENCH_soak_r01..r04, MULTICHIP_r01..r06 — each with its own round-era schema.
+perfwatch ingests EVERY artifact into one versioned trajectory document
+(``PERF_TRAJECTORY.json``, schema ``pstpu-perf-trajectory-v1``), renders the
+trend table inside docs/PERF.md's marker block (same freshness contract as
+the gen_docs metrics tables), and gates fresh bench results against budgets
+derived from comparable historical entries:
+
+    python tools/perfwatch.py                      # rebuild trajectory + docs
+    python tools/perfwatch.py --check-docs         # freshness gate (CI/PL004-style)
+    python tools/perfwatch.py --ingest-line L.json --trajectory T.json
+    python tools/perfwatch.py --check L.json --trajectory T.json [--tolerance 0.3]
+
+``--check`` exits nonzero when the fresh bench JSON line regresses
+output tok/s, p50 TTFT, kv_hit_rate, effective tokens/target-step, or the
+zero-5xx bar past the budget derived from the best comparable entry (same
+family + backend). With no comparable baseline it passes with a warning —
+a new backend/workload cannot regress against nothing. The CI "Perf
+sentinel" step ingests the honest smoke line into a scratch trajectory
+first, so the gate is machine-speed independent: a doctored line must fail
+against the very machine that produced it.
+
+Loaders are structural (sniff the document shape, not the filename), so a
+future round's artifact that keeps any known shape keeps ingesting; an
+unrecognized shape becomes a zero-metric ``smoke`` entry rather than an
+error — history is append-only and must never rot the sentinel.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+SCHEMA = "pstpu-perf-trajectory-v1"
+TRAJECTORY_PATH = "PERF_TRAJECTORY.json"
+DOCS_PATH = "docs/PERF.md"
+#: Budget tolerance: fresh tok/s (and the other larger-is-better metrics)
+#: may sit this far below the best comparable baseline before --check
+#: fails; p50 TTFT may sit this far above. 0.3 keeps an honest re-run of
+#: the same line green while a halved throughput (the CI doctored
+#: self-test) is an unambiguous regression.
+DEFAULT_TOLERANCE = 0.3
+
+#: Metric keys a trajectory entry may carry. Larger-is-better unless noted.
+METRIC_KEYS = (
+    "output_tok_s",
+    "p50_ttft_s",                        # smaller is better
+    "kv_hit_rate",
+    "hbm_bw_pct",
+    "effective_tokens_per_target_step",
+    "attainment",
+    "errors_total",                      # must be 0
+    "status_5xx",                        # must be 0
+    "tok_per_s_per_chip",
+    "scaling_efficiency",
+)
+
+
+def _num(v) -> Optional[float]:
+    """Coerce to float, or None for anything non-numeric (schema drift in a
+    historical artifact must degrade to a missing metric, not a crash)."""
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    return float(v)
+
+
+def _entry(source: str, family: str, variant: str, backend: str = "",
+           metrics: Optional[Dict[str, Optional[float]]] = None,
+           note: str = "") -> dict:
+    clean = {k: _num(v) for k, v in (metrics or {}).items()
+             if k in METRIC_KEYS and _num(v) is not None}
+    e = {"source": source, "family": family, "variant": variant,
+         "backend": backend or "", "metrics": clean}
+    if note:
+        e["note"] = note
+    return e
+
+
+def _from_bench_line(line: dict) -> Dict[str, Optional[float]]:
+    """Metric dict from a bench.py one-line JSON record (any era: older
+    lines simply lack the newer keys)."""
+    out: Dict[str, Optional[float]] = {}
+    if line.get("unit") == "tok/s":
+        out["output_tok_s"] = line.get("value")
+    for k in ("p50_ttft_s", "kv_hit_rate", "hbm_bw_pct",
+              "effective_tokens_per_target_step", "errors_total",
+              "tok_per_s_per_chip"):
+        if k in line:
+            out[k] = line.get(k)
+    return out
+
+
+# ------------------------------------------------------------------ loaders
+def _load_wrapper(source, doc) -> List[dict]:
+    """Round 1-6 shape: {n, cmd, rc, tail, parsed[, parsed_disagg]}."""
+    out = []
+    for key, variant in (("parsed", "stack"), ("parsed_disagg", "disagg")):
+        line = doc.get(key)
+        if isinstance(line, dict):
+            out.append(_entry(source, "bench", variant,
+                              line.get("backend", ""),
+                              _from_bench_line(line)))
+    if not out and doc.get("rc") is not None:
+        out.append(_entry(source, "bench", "smoke",
+                          metrics={"errors_total":
+                                   0 if doc.get("rc") == 0 else 1},
+                          note="wrapper with no parsed line"))
+    return out
+
+
+def _load_comparison(source, doc) -> List[dict]:
+    """Round 7/8/9 shape: two named bench lines side by side."""
+    out = []
+    for variant in ("roundrobin", "prefix_aware", "spec_off", "spec_on",
+                    "cold", "warm"):
+        line = doc.get(variant)
+        if isinstance(line, dict) and "value" in line:
+            m = _from_bench_line(line)
+            # Round 8 carries the effective-tokens factor at top level.
+            eff = doc.get("effective_tokens_per_target_step")
+            if isinstance(eff, dict) and _num(eff.get(variant)) is not None:
+                m["effective_tokens_per_target_step"] = eff[variant]
+            out.append(_entry(source, "bench", variant,
+                              line.get("backend", ""), m))
+    return out
+
+
+def _load_spec_modes(source, doc) -> List[dict]:
+    """Round 10 shape: modes{off,linear,tree,adaptive} x workloads."""
+    out = []
+    backend = doc.get("backend", "")
+    eff = doc.get("effective_tokens_per_target_step", {})
+    for mode, workloads in doc["modes"].items():
+        if not isinstance(workloads, dict):
+            continue
+        for wl, stats in workloads.items():
+            if not isinstance(stats, dict):
+                continue
+            m = {"output_tok_s": stats.get("output_tok_s"),
+                 "effective_tokens_per_target_step":
+                     stats.get("effective_tokens_per_target_step")}
+            if m["effective_tokens_per_target_step"] is None and \
+                    isinstance(eff.get(mode), dict):
+                m["effective_tokens_per_target_step"] = eff[mode].get(wl)
+            out.append(_entry(source, "bench", f"{mode}:{wl}", backend, m))
+    return out
+
+
+def _load_soak(source, doc) -> List[dict]:
+    """pstpu-soak-v1: one entry per SLO class at the LAST ladder rung (peak
+    sustained load), plus the run-wide zero-5xx bar."""
+    out = []
+    backend = doc.get("backend", "")
+    ladder = doc.get("ladder") or []
+    totals = doc.get("totals") or {}
+    zero_5xx = doc.get("zero_5xx")
+    if zero_5xx is None:
+        zero_5xx = _num(totals.get("status_5xx")) == 0.0
+    rung = ladder[-1] if ladder else {}
+    for cls, stats in (rung.get("classes") or {}).items():
+        if not isinstance(stats, dict):
+            continue
+        out.append(_entry(
+            source, "soak", cls, backend,
+            {"output_tok_s": stats.get("output_tok_s"),
+             "p50_ttft_s": stats.get("p50_ttft_s"),
+             "attainment": stats.get("attainment"),
+             "status_5xx": stats.get("status_5xx"),
+             "errors_total": stats.get("errors")},
+        ))
+    out.append(_entry(
+        source, "soak", "totals", backend,
+        {"errors_total": totals.get("errors"),
+         "status_5xx": 0 if zero_5xx else
+         (totals.get("status_5xx") if totals.get("status_5xx") is not None
+          else 1)},
+    ))
+    return out
+
+
+def _load_multichip_curve(source, doc) -> List[dict]:
+    """MULTICHIP scaling-curve shape: one entry per chip-count point."""
+    out = []
+    backend = doc.get("backend", "")
+    for point in doc.get("curve", []):
+        if not isinstance(point, dict):
+            continue
+        out.append(_entry(
+            source, "multichip", f"{point.get('chips', '?')}chip", backend,
+            {"output_tok_s": point.get("tok_s"),
+             "tok_per_s_per_chip": point.get("tok_per_s_per_chip"),
+             "scaling_efficiency": point.get("scaling_efficiency"),
+             "p50_ttft_s": point.get("p50_ttft_s"),
+             "hbm_bw_pct": point.get("hbm_bw_pct"),
+             "errors_total": point.get("errors_total")},
+        ))
+    return out
+
+
+def _load_multichip_smoke(source, doc) -> List[dict]:
+    """MULTICHIP r01-r05 shape: pass/fail smoke with no perf metrics."""
+    ok = bool(doc.get("ok")) and not doc.get("skipped")
+    return [_entry(
+        source, "multichip", "smoke", "",
+        {"errors_total": 0 if ok else 1},
+        note=f"n_devices={doc.get('n_devices')} rc={doc.get('rc')}"
+             f"{' skipped' if doc.get('skipped') else ''}",
+    )]
+
+
+def load_artifact(path: str) -> List[dict]:
+    """Trajectory entries from one artifact, sniffed structurally."""
+    source = os.path.basename(path)
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [_entry(source, "bench", "unreadable",
+                       metrics={"errors_total": 1}, note=str(e))]
+    if not isinstance(doc, dict):
+        return [_entry(source, "bench", "smoke", note="non-object artifact")]
+    if "parsed" in doc or ("rc" in doc and "cmd" in doc):
+        return _load_wrapper(source, doc)
+    if isinstance(doc.get("modes"), dict):
+        return _load_spec_modes(source, doc)
+    if doc.get("schema") == "pstpu-soak-v1" or "ladder" in doc:
+        return _load_soak(source, doc)
+    if isinstance(doc.get("curve"), list):
+        return _load_multichip_curve(source, doc)
+    if any(k in doc for k in ("roundrobin", "spec_off", "cold")):
+        return _load_comparison(source, doc)
+    if "n_devices" in doc:
+        return _load_multichip_smoke(source, doc)
+    if "value" in doc and "metric" in doc:
+        # A bare bench one-line record checked in as-is.
+        return [_entry(source, "bench", "stack", doc.get("backend", ""),
+                       _from_bench_line(doc))]
+    return [_entry(source, "bench", "smoke", note="unrecognized shape")]
+
+
+def discover_artifacts(project_root: str) -> List[str]:
+    pats = ("BENCH_r*.json", "BENCH_soak_r*.json", "MULTICHIP_r*.json")
+    paths: List[str] = []
+    for pat in pats:
+        paths.extend(glob.glob(os.path.join(project_root, pat)))
+    return sorted(paths)
+
+
+def build_trajectory(project_root: str) -> dict:
+    entries: List[dict] = []
+    for path in discover_artifacts(project_root):
+        entries.extend(load_artifact(path))
+    return {"schema": SCHEMA, "entries": entries}
+
+
+# --------------------------------------------------------------- validation
+def validate_trajectory(doc) -> List[str]:
+    """Hand-rolled schema gate (no jsonschema dependency): every problem as
+    a human-readable string; [] means valid."""
+    problems = []
+    if not isinstance(doc, dict):
+        return ["trajectory document is not an object"]
+    if doc.get("schema") != SCHEMA:
+        problems.append(f"schema is {doc.get('schema')!r}, want {SCHEMA!r}")
+    entries = doc.get("entries")
+    if not isinstance(entries, list):
+        return problems + ["'entries' is not a list"]
+    for i, e in enumerate(entries):
+        where = f"entries[{i}]"
+        if not isinstance(e, dict):
+            problems.append(f"{where} is not an object")
+            continue
+        for key in ("source", "family", "variant", "backend"):
+            if not isinstance(e.get(key), str):
+                problems.append(f"{where}.{key} missing or not a string")
+        if e.get("family") not in ("bench", "soak", "multichip"):
+            problems.append(f"{where}.family {e.get('family')!r} unknown")
+        metrics = e.get("metrics")
+        if not isinstance(metrics, dict):
+            problems.append(f"{where}.metrics missing or not an object")
+            continue
+        for k, v in metrics.items():
+            if k not in METRIC_KEYS:
+                problems.append(f"{where}.metrics has unknown key {k!r}")
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                problems.append(f"{where}.metrics[{k!r}] is not a number")
+    return problems
+
+
+# ---------------------------------------------------------------- the docs
+def _fmt(v: Optional[float], digits: int = 2) -> str:
+    if v is None:
+        return "—"
+    if float(v).is_integer() and abs(v) < 1e6:
+        return str(int(v))
+    return f"{v:.{digits}f}"
+
+
+def render_trend_table(doc: dict) -> str:
+    lines = [
+        "| Source | Family | Variant | Backend | tok/s | p50 TTFT (s) "
+        "| KV hit | Eff tok/step | Errors |",
+        "|---|---|---|---|---|---|---|---|---|",
+    ]
+    for e in doc["entries"]:
+        m = e["metrics"]
+        errors = m.get("errors_total")
+        if errors is None:
+            errors = m.get("status_5xx")
+        lines.append(
+            f"| {e['source']} | {e['family']} | {e['variant']} "
+            f"| {e['backend'] or '—'} | {_fmt(m.get('output_tok_s'))} "
+            f"| {_fmt(m.get('p50_ttft_s'), 4)} "
+            f"| {_fmt(m.get('kv_hit_rate'), 3)} "
+            f"| {_fmt(m.get('effective_tokens_per_target_step'), 4)} "
+            f"| {_fmt(errors, 0)} |"
+        )
+    return "\n".join(lines)
+
+
+def sync_docs(project_root: str, doc: dict, write: bool) -> List[str]:
+    """Refresh (write=True) or report (write=False) the docs/PERF.md trend
+    block; returns problem strings, [] when fresh. Reuses the gen_docs
+    marker machinery so the freshness semantics match the metrics tables."""
+    try:
+        from tools.pstpu_lint.gen_docs import _update_block
+    except ModuleNotFoundError:
+        # Invoked as `python tools/perfwatch.py`: sys.path[0] is tools/,
+        # not the repo root the package imports resolve from.
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        from tools.pstpu_lint.gen_docs import _update_block
+
+    path = os.path.join(project_root, DOCS_PATH)
+    if not os.path.exists(path):
+        return [f"{DOCS_PATH}: missing"]
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    updated = _update_block(text, "perf-trajectory", "trend",
+                            render_trend_table(doc))
+    if updated is None:
+        return [f"{DOCS_PATH}: missing the "
+                f"<!-- pstpu-perf-trajectory:BEGIN trend --> marker block"]
+    if updated != text:
+        if write:
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(updated)
+        else:
+            return [f"{DOCS_PATH}: trend table out of date; run "
+                    f"python tools/perfwatch.py"]
+    return []
+
+
+# --------------------------------------------------------------- the gate
+def _comparable(entries: List[dict], fresh_backend: str) -> List[dict]:
+    return [e for e in entries
+            if e.get("family") == "bench"
+            and (e.get("backend") or "") == (fresh_backend or "")
+            and e.get("metrics")]
+
+
+def check_line(doc: dict, line: dict,
+               tolerance: float = DEFAULT_TOLERANCE) -> List[str]:
+    """Budget gate: regression strings for a fresh bench line against the
+    best comparable trajectory entries; [] means within budget."""
+    problems = []
+    fresh = _from_bench_line(line)
+    errors = fresh.get("errors_total")
+    if errors is not None and errors > 0:
+        problems.append(f"zero-5xx bar: fresh line has "
+                        f"errors_total={int(errors)} (must be 0)")
+    pool = _comparable(doc.get("entries", []), line.get("backend", ""))
+    if not pool:
+        print(f"perfwatch: no comparable baseline (family=bench, "
+              f"backend={line.get('backend', '')!r}) — passing with a "
+              f"warning", file=sys.stderr)
+        return problems
+
+    def best(key, better=max):
+        vals = [e["metrics"][key] for e in pool if key in e["metrics"]]
+        return better(vals) if vals else None
+
+    # Larger-is-better floors.
+    for key, label in (("output_tok_s", "tok/s"),
+                       ("kv_hit_rate", "kv_hit_rate"),
+                       ("effective_tokens_per_target_step",
+                        "effective tokens/target-step")):
+        base = best(key)
+        got = fresh.get(key)
+        if base is None or base <= 0 or got is None:
+            continue
+        floor = base * (1.0 - tolerance)
+        if got < floor:
+            problems.append(
+                f"{label} regression: {got:.4g} < budget {floor:.4g} "
+                f"(best comparable {base:.4g}, tolerance {tolerance:.0%})"
+            )
+    # Smaller-is-better ceiling.
+    base = best("p50_ttft_s", better=min)
+    got = fresh.get("p50_ttft_s")
+    if base is not None and base > 0 and got is not None:
+        ceiling = base * (1.0 + tolerance)
+        if got > ceiling:
+            problems.append(
+                f"p50 TTFT regression: {got:.4g}s > budget {ceiling:.4g}s "
+                f"(best comparable {base:.4g}s, tolerance {tolerance:.0%})"
+            )
+    return problems
+
+
+def ingest_line(doc: dict, line: dict, source: str = "fresh") -> dict:
+    doc.setdefault("entries", []).append(_entry(
+        source, "bench", "stack", line.get("backend", ""),
+        _from_bench_line(line),
+    ))
+    return doc
+
+
+# --------------------------------------------------------------------- CLI
+def _load_json(path: str):
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def _load_trajectory(path: str) -> dict:
+    doc = _load_json(path)
+    problems = validate_trajectory(doc)
+    if problems:
+        for p in problems:
+            print(f"perfwatch: {path}: {p}", file=sys.stderr)
+        raise SystemExit(2)
+    return doc
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python tools/perfwatch.py",
+        description="Perf-trajectory sentinel: ingest round artifacts, "
+                    "render the docs trend table, gate fresh bench lines.",
+    )
+    p.add_argument("--project-root", default=".")
+    p.add_argument("--trajectory", default=None,
+                   help=f"trajectory file (default: {TRAJECTORY_PATH} "
+                        f"under --project-root)")
+    p.add_argument("--check-docs", action="store_true",
+                   help="verify PERF_TRAJECTORY.json and the docs/PERF.md "
+                        "trend table are up to date (exit 1 when stale)")
+    p.add_argument("--ingest-line", metavar="LINE_JSON",
+                   help="append a bench one-line JSON record to the "
+                        "trajectory file")
+    p.add_argument("--check", metavar="LINE_JSON",
+                   help="gate a bench one-line JSON record against the "
+                        "trajectory budgets (exit 1 on regression)")
+    p.add_argument("--source", default="fresh",
+                   help="source label recorded by --ingest-line")
+    p.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                   help="budget tolerance fraction (default %(default)s)")
+    args = p.parse_args(argv)
+    root = os.path.abspath(args.project_root)
+    traj_path = args.trajectory or os.path.join(root, TRAJECTORY_PATH)
+
+    if args.check:
+        doc = _load_trajectory(traj_path)
+        problems = check_line(doc, _load_json(args.check), args.tolerance)
+        for prob in problems:
+            print(f"perfwatch: REGRESSION: {prob}", file=sys.stderr)
+        if not problems:
+            print("perfwatch: within budget")
+        return 1 if problems else 0
+
+    if args.ingest_line:
+        doc = (_load_trajectory(traj_path) if os.path.exists(traj_path)
+               else {"schema": SCHEMA, "entries": []})
+        ingest_line(doc, _load_json(args.ingest_line), args.source)
+        problems = validate_trajectory(doc)
+        if problems:
+            for prob in problems:
+                print(f"perfwatch: {prob}", file=sys.stderr)
+            return 2
+        with open(traj_path, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        print(f"perfwatch: ingested {args.ingest_line} into {traj_path}")
+        return 0
+
+    built = build_trajectory(root)
+    problems = validate_trajectory(built)
+    if problems:
+        for prob in problems:
+            print(f"perfwatch: built trajectory invalid: {prob}",
+                  file=sys.stderr)
+        return 2
+
+    if args.check_docs:
+        stale = []
+        if not os.path.exists(traj_path):
+            stale.append(f"{traj_path}: missing; run python "
+                         f"tools/perfwatch.py")
+        else:
+            current = _load_json(traj_path)
+            if current != built:
+                stale.append(f"{traj_path}: out of date with the checked-in "
+                             f"artifacts; run python tools/perfwatch.py")
+            stale.extend(validate_trajectory(current))
+        stale.extend(sync_docs(root, built, write=False))
+        for prob in stale:
+            print(f"perfwatch: {prob}", file=sys.stderr)
+        return 1 if stale else 0
+
+    with open(traj_path, "w", encoding="utf-8") as f:
+        json.dump(built, f, indent=1)
+        f.write("\n")
+    print(f"perfwatch: wrote {traj_path} "
+          f"({len(built['entries'])} entries from "
+          f"{len(discover_artifacts(root))} artifacts)")
+    for prob in sync_docs(root, built, write=True):
+        print(f"perfwatch: {prob}", file=sys.stderr)
+        return 2
+    print(f"perfwatch: refreshed {DOCS_PATH} trend table")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,6 +39,7 @@ TABLES = {
     "multichip": "docs/PERF.md",
     "elastic": "docs/ELASTIC.md",
     "lifecycle": "docs/OBSERVABILITY.md",
+    "fleet-perf": "docs/OBSERVABILITY.md",
 }
 
 FLAG_TABLES = {

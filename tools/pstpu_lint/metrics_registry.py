@@ -372,6 +372,64 @@ REGISTRY: Tuple[Series, ...] = (
            ("catalogue", "disagg"),
            "Disagg-routed requests degraded to unified serving",
            router_labels=("reason",)),
+    # -------------------------------------- engine: live roofline telemetry
+    # (docs/OBSERVABILITY.md fleet pane): the engine reports its OWN
+    # roofline position continuously from the rolling dispatch window —
+    # the same arithmetic bench.py's JSON line uses (shared
+    # production_stack_tpu/perf/roofline.py).
+    Series("pstpu:live_tok_per_s", "gauge", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "fleet-perf"),
+           "Generation throughput over the rolling dispatch window"),
+    Series("pstpu:live_hbm_bw_pct", "gauge", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "fleet-perf"),
+           "Achieved fraction (percent) of the decode HBM roofline for "
+           "the current batch shape"),
+    Series("pstpu:live_effective_tokens_per_target_step", "gauge",
+           ("model_name",), _BOTH_ENGINE, ("catalogue", "fleet-perf"),
+           "Tokens emitted per target-model step over the rolling window "
+           "(the Leviathan'23 amortization factor; >1 only when "
+           "speculation pays)"),
+    Series("pstpu:host_stall_seconds_total", "counter", ("model_name",),
+           _BOTH_ENGINE, ("catalogue", "fleet-perf"),
+           "Fetch-done to next issue-start gap with nothing outstanding "
+           "on device (host scheduling stall, compile time excluded)"),
+    Series("pstpu:dispatch_duration_seconds", "histogram",
+           ("model_name", "train"), _BOTH_ENGINE,
+           ("catalogue", "fleet-perf"),
+           "Issue-to-fetch duration of each dispatch by train kind "
+           "(prefill | decode | decode_spec)"),
+    # ------------------------------------------------ router: fleet pane
+    # One operator surface over what the scraper already holds per
+    # backend (GET /fleet serves the JSON view of the same aggregate).
+    Series("router_fleet_backends", "gauge", (), (ROUTER,),
+           ("catalogue", "fleet-perf"),
+           "Backends in the router's current fleet view (healthy "
+           "serving endpoints)",
+           router_labels=()),
+    Series("router_fleet_live_tok_per_s", "gauge", (), (ROUTER,),
+           ("catalogue", "fleet-perf"),
+           "Engine-reported live generation throughput per backend",
+           router_labels=("server",)),
+    Series("router_fleet_live_hbm_bw_pct", "gauge", (), (ROUTER,),
+           ("catalogue", "fleet-perf"),
+           "Engine-reported live roofline position per backend "
+           "(percent of the decode HBM ceiling)",
+           router_labels=("server",)),
+    Series("router_fleet_live_effective_tokens_per_target_step", "gauge",
+           (), (ROUTER,), ("catalogue", "fleet-perf"),
+           "Engine-reported tokens emitted per target-model step per "
+           "backend (speculation amortization)",
+           router_labels=("server",)),
+    Series("router_fleet_breaker_open", "gauge", (), (ROUTER,),
+           ("catalogue", "fleet-perf"),
+           "Circuit-breaker position per backend (0 closed / 1 open / "
+           "2 half-open) in the fleet view",
+           router_labels=("server",)),
+    Series("router_fleet_ramp_in_penalty", "gauge", (), (ROUTER,),
+           ("catalogue", "fleet-perf"),
+           "Remaining ramp-in load penalty per backend (1 just joined "
+           "-> 0 fully ramped)",
+           router_labels=("server",)),
 )
 
 

@@ -146,11 +146,14 @@ def extract_engine_collector(engine_src: str) -> Extracted:
                 _add(name, kind, default_labels, node.lineno)
         elif isinstance(fn, ast.Name) and fn.id in (
             "GaugeMetricFamily", "CounterMetricFamily",
+            "HistogramMetricFamily",
         ):
             name = _const_str(node.args[0]) if node.args else None
             if not name:
                 continue
-            kind = "gauge" if fn.id.startswith("Gauge") else "counter"
+            kind = ("gauge" if fn.id.startswith("Gauge")
+                    else "counter" if fn.id.startswith("Counter")
+                    else "histogram")
             if kind == "counter" and not name.endswith("_total"):
                 name += "_total"   # prometheus_client appends _total
             labels = None
