@@ -288,6 +288,8 @@ class UserSession:
                             prompt_tokens = usage.get("prompt_tokens", 0)
                             generation_tokens = usage.get(
                                 "completion_tokens", 0)
+                        # Registry-pinned payload keys (PL011 checks this
+                        # consumer reads toks/off/seed; docs/HTTP_PROTOCOL.md).
                         meta = chunk.get("pstpu")
                         if isinstance(meta, dict):
                             if isinstance(meta.get("seed"), int) and \

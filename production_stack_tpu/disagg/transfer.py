@@ -45,6 +45,8 @@ ENGINE_ROLES = ("unified", "prefill", "decode")
 
 # Router<->engine disagg headers (request_service.py sets them; the API
 # server reads them). Kept here so both planes import one definition.
+# Every name below is registered in tools/pstpu_lint/http_registry.py —
+# adding one here without a registry entry fails the PL011 lint gate.
 DISAGG_ROLE_HEADER = "x-pstpu-disagg"            # hop marker: "decode"
 DISAGG_KEY_HEADER = "x-pstpu-transfer-key"       # store key for the bundle
 DISAGG_ENDPOINT_HEADER = "x-pstpu-endpoint"      # "chat" | "completions"

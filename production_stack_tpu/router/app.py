@@ -133,7 +133,8 @@ async def handle_health(request: web.Request) -> web.Response:
         problems.append("engine stats scraper is down")
     if problems:
         return web.json_response({"status": "unhealthy",
-                                  "problems": problems}, status=503)
+                                  "problems": problems}, status=503,
+                                 headers={"Retry-After": "1"})
     payload = {"status": "healthy"}
     resilience = get_resilience()
     if resilience is not None:

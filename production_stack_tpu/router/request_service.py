@@ -427,6 +427,8 @@ async def _attach_resume_stream(
         name: val for name, val in base_headers.items()
         if not name.lower().startswith("x-pstpu-")
     }
+    # Both synthetic headers are registry surface (PL011 —
+    # tools/pstpu_lint/http_registry.py pins producer and consumer planes).
     headers[DISAGG_FALLBACK_HEADER] = "1"
     headers[RESUME_HEADER] = "1"
     # The routing policy sees the CLIENT's headers (session keys,

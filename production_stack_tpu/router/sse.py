@@ -144,6 +144,9 @@ class SseResumeParser:
             return True          # not ours to judge: forward untouched
         if not isinstance(obj, dict):
             return True
+        # Field names are the registry-pinned SSE payload contract
+        # (tools/pstpu_lint/http_registry.py; PL011 checks each consumer
+        # reads every registered key).
         meta = obj.get("pstpu")
         toks = meta.get("toks") if isinstance(meta, dict) else None
         off = meta.get("off") if isinstance(meta, dict) else None

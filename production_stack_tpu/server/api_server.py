@@ -338,7 +338,8 @@ class APIServer:
         except Exception as e:  # noqa: BLE001 — capture start must not 500
             logger.exception("Device profiling arm failed")
             return _error(503, f"Profiler failed to start: {e}",
-                          etype="service_unavailable")
+                          etype="service_unavailable",
+                          headers={"Retry-After": "1"})
         return web.json_response({"status": "armed", **info})
 
     async def debug_profile_status(self,
@@ -466,10 +467,12 @@ class APIServer:
             return web.json_response(
                 {"status": "draining", "inflight": self._inflight},
                 status=503,
+                headers={"Retry-After": "1"},
             )
         if self.engine.is_healthy:
             return web.json_response({"status": "healthy"})
-        return web.json_response({"status": "unhealthy"}, status=503)
+        return web.json_response({"status": "unhealthy"}, status=503,
+                                 headers={"Retry-After": "1"})
 
     async def metrics(self, request: web.Request) -> web.Response:
         return web.Response(

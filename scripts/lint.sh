@@ -28,11 +28,14 @@ fi
 if python -m mypy --version >/dev/null 2>&1; then
     # Scope: the router + disagg + kv_offload tiers (the asyncio data
     # plane and the wire-protocol codecs, where type confusion turns into
-    # 3am pages or corrupted stores). Widen as annotations land; config
-    # and per-flag rationale live under [tool.mypy] in pyproject.toml.
-    echo "== mypy (scoped: router/ + disagg/ + kv_offload/)"
+    # 3am pages or corrupted stores) + server/ (the engine API surface —
+    # the other half of the HTTP contract PL011-PL013 lint; a handler
+    # returning the wrong shape is a protocol break, not a unit bug).
+    # Widen as annotations land; config and per-flag rationale live under
+    # [tool.mypy] in pyproject.toml.
+    echo "== mypy (scoped: router/ + disagg/ + kv_offload/ + server/)"
     python -m mypy production_stack_tpu/router production_stack_tpu/disagg \
-        production_stack_tpu/kv_offload \
+        production_stack_tpu/kv_offload production_stack_tpu/server \
         || fail=1
 else
     echo "== mypy not installed — skipping (pip install -e .[lint])"

@@ -30,8 +30,10 @@ harness's chaos executor drives engines cross-process with it):
     {"action": "die_mid_body", "once": true}
     {"action": "heal"}
 
-Mid-stream resume protocol (docs/RESILIENCE.md): streamed chunks carry the
-real engine's ``pstpu`` payload — deterministic token ids (BASE_TOKEN + i),
+Mid-stream resume protocol (docs/RESILIENCE.md): when the caller opts in
+via the x-pstpu-resume header (the router always does on proxied streams),
+streamed chunks carry the real engine's ``pstpu`` payload — deterministic
+token ids (BASE_TOKEN + i),
 their offset, and a fixed seed — and a request body carrying
 ``resume_tokens`` continues the stream at that offset, so the router's
 splice logic is testable without spawning real engines (the
@@ -145,10 +147,15 @@ class FakeEngine:
         app = web.Application(middlewares=[trace])
         app.router.add_post("/v1/chat/completions", self.chat)
         app.router.add_post("/v1/completions", self.completions)
+        app.router.add_post("/v1/embeddings", self.embeddings)
+        app.router.add_post("/v1/rerank", self.rerank)
+        app.router.add_post("/rerank", self.rerank)
         app.router.add_get("/v1/models", self.models)
         app.router.add_get("/health", self.health)
         app.router.add_get("/metrics", self.metrics)
         app.router.add_get("/prefix_index", self.prefix_index)
+        app.router.add_post("/prewarm", self.prewarm)
+        app.router.add_get("/version", self.version)
         app.router.add_post("/fault", self.fault)
         return app
 
@@ -196,6 +203,86 @@ class FakeEngine:
                 {"error": f"unknown fault action {action!r}"}, status=400
             )
         return web.json_response({"status": "ok", "action": action})
+
+    async def embeddings(self, request):
+        """Deterministic unit vectors in the real engine's /v1/embeddings
+        shape: input i embeds to a 4-dim one-hot-ish vector keyed on the
+        text hash, so rerank scores are stable across calls."""
+        body = json.loads(await request.read())
+        inputs = body.get("input")
+        if isinstance(inputs, str):
+            inputs = [inputs]
+        if not inputs or not all(isinstance(x, str) for x in inputs):
+            return web.json_response(
+                {"error": {"message": "'input' must be a string or list "
+                                      "of strings",
+                           "type": "invalid_request_error", "code": 400}},
+                status=400)
+        self.requests_seen.append(("/v1/embeddings", body))
+        data = [{"object": "embedding", "index": i,
+                 "embedding": self._embed_one(text)}
+                for i, text in enumerate(inputs)]
+        return web.json_response({
+            "object": "list", "data": data, "model": self.model,
+            "usage": {"prompt_tokens": len(inputs),
+                      "total_tokens": len(inputs)},
+        })
+
+    @staticmethod
+    def _embed_one(text: str):
+        # Stable pseudo-embedding: bucket of the text's char sum. Same
+        # string -> same vector, distinct strings usually differ.
+        k = sum(ord(c) for c in text) % 4
+        vec = [0.0, 0.0, 0.0, 0.0]
+        vec[k] = 1.0
+        return vec
+
+    async def rerank(self, request):
+        """Cosine rerank over the fake embeddings (real /rerank shape)."""
+        body = json.loads(await request.read())
+        query = body.get("query")
+        documents = body.get("documents")
+        if not isinstance(query, str) or not isinstance(documents, list):
+            return web.json_response(
+                {"error": {"message": "'query' (str) and 'documents' "
+                                      "(list[str]) are required",
+                           "type": "invalid_request_error", "code": 400}},
+                status=400)
+        self.requests_seen.append(("/rerank", body))
+        qv = self._embed_one(query)
+        scored = [
+            (i, sum(a * b for a, b in zip(qv, self._embed_one(d))))
+            for i, d in enumerate(documents)
+        ]
+        scored.sort(key=lambda t: (-t[1], t[0]))
+        top_n = body.get("top_n", len(documents))
+        return web.json_response({
+            "id": "fake-rerank", "model": self.model,
+            "results": [
+                {"index": i, "document": {"text": documents[i]},
+                 "relevance_score": s}
+                for i, s in scored[:top_n]
+            ],
+            "usage": {"prompt_tokens": len(documents) + 1,
+                      "total_tokens": len(documents) + 1},
+        })
+
+    async def prewarm(self, request):
+        """Prefix prewarm in the real engine's shape (api_server.prewarm);
+        the fake has no shared KV tier, so it reports zero restored
+        chains but validates the contract fields."""
+        raw = await request.read()
+        body = json.loads(raw) if raw else {}
+        self.requests_seen.append(("/prewarm", body))
+        return web.json_response({
+            "status": "ok",
+            "chains_restored": 0,
+            "blocks_restored": 0,
+            "tokens_restored": 0,
+        })
+
+    async def version(self, request):
+        return web.json_response({"version": "fake"})
 
     async def models(self, request):
         return web.json_response({
@@ -335,10 +422,14 @@ class FakeEngine:
                         ),
                     }],
                 }
-                if self.speak_resume_protocol:
+                if self.speak_resume_protocol and \
+                        request.headers.get("x-pstpu-resume"):
                     # Resume payload in the real engine's shape: this
                     # chunk's token ids, their output offset, and the
-                    # resolved sampler seed base.
+                    # resolved sampler seed base. Same opt-in contract as
+                    # the real engine: only emitted when the router asked
+                    # via x-pstpu-resume; direct clients get pristine
+                    # OpenAI chunks.
                     chunk["pstpu"] = {"toks": [BASE_TOKEN + i], "off": i,
                                       "seed": FAKE_SEED}
                 await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())

@@ -1,0 +1,107 @@
+"""Protocol parity of tests/fake_engine.py with the real engine surface.
+
+PL012 (docs/LINTING.md) statically pins that the fake registers every
+route the registry assigns to the ``fake`` plane; these tests exercise
+the handlers end-to-end — response shapes in the real engine's contract
+(docs/HTTP_PROTOCOL.md), deterministic rerank ordering, and the
+x-pstpu-resume opt-in gate PL011's consumer leg requires the fake to
+honor like the real engine does.
+"""
+
+import contextlib
+import json
+import os
+import sys
+
+from aiohttp.test_utils import TestClient, TestServer
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tests.fake_engine import BASE_TOKEN, FAKE_SEED, FakeEngine  # noqa: E402
+
+
+@contextlib.asynccontextmanager
+async def fake_client():
+    # conftest runs async tests via asyncio.run but has no async-fixture
+    # support, so the client lives in a context manager instead.
+    engine = FakeEngine(speed=0.0)
+    c = TestClient(TestServer(engine.build_app()))
+    await c.start_server()
+    try:
+        yield c, engine
+    finally:
+        await c.close()
+
+
+async def test_version_and_prewarm_shapes():
+    async with fake_client() as (c, _engine):
+        resp = await c.get("/version")
+        assert resp.status == 200
+        assert "version" in await resp.json()
+
+        resp = await c.post("/prewarm", json={"top_k": 4})
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["status"] == "ok"
+        # The real engine's result fields (api_server.prewarm): a fake has
+        # no shared KV tier, so the counters are present but zero.
+        assert body["chains_restored"] == 0
+        assert body["blocks_restored"] == 0
+
+
+async def test_embeddings_shape_and_determinism():
+    async with fake_client() as (c, engine):
+        req = {"input": ["alpha", "beta"], "model": "m"}
+        resp = await c.post("/v1/embeddings", json=req)
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["object"] == "list"
+        assert [d["index"] for d in body["data"]] == [0, 1]
+        again = await (await c.post("/v1/embeddings", json=req)).json()
+        assert again["data"] == body["data"]   # same text -> same vector
+
+        resp = await c.post("/v1/embeddings", json={"input": [1, 2]})
+        assert resp.status == 400
+        assert ("/v1/embeddings", req) in engine.requests_seen
+
+
+async def test_rerank_orders_by_similarity():
+    async with fake_client() as (c, _engine):
+        docs = ["xx", "yy", "zz"]
+        for path in ("/rerank", "/v1/rerank"):
+            resp = await c.post(path,
+                                json={"query": "xx", "documents": docs})
+            assert resp.status == 200
+            body = await resp.json()
+            scores = [r["relevance_score"] for r in body["results"]]
+            assert scores == sorted(scores, reverse=True)
+            assert {r["document"]["text"] for r in body["results"]} == \
+                set(docs)
+        resp = await c.post("/rerank",
+                            json={"query": 1, "documents": "nope"})
+        assert resp.status == 400
+
+
+async def test_pstpu_payload_requires_opt_in_header():
+    """The fake honors the real engine's opt-in contract: no
+    x-pstpu-resume header, no pstpu payload — pristine OpenAI chunks."""
+    async with fake_client() as (c, _engine):
+        body = {"prompt": "p", "max_tokens": 3, "stream": True}
+
+        raw = (await (await c.post("/v1/completions", json=body))
+               .content.read()).decode()
+        assert '"pstpu"' not in raw
+        assert "data: [DONE]" in raw
+
+        raw = (await (await c.post(
+            "/v1/completions", json=body,
+            headers={"x-pstpu-resume": "1"},
+        )).content.read()).decode()
+        chunks = [json.loads(ln[5:]) for ln in raw.splitlines()
+                  if ln.startswith("data:") and ln != "data: [DONE]"]
+        assert all("pstpu" in ch for ch in chunks)
+        assert [t for ch in chunks for t in ch["pstpu"]["toks"]] == \
+            [BASE_TOKEN + i for i in range(3)]
+        assert {ch["pstpu"]["seed"] for ch in chunks} == {FAKE_SEED}

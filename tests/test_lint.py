@@ -998,6 +998,359 @@ class TestWireDrift:
         assert "native" in findings[0].message
 
 
+# ---------------------------------------------------- PL011/PL012/PL013
+class TestHttpDrift:
+    """HTTP control-surface drift against synthetic trees with injected
+    registries (docs_check=False — the freshness leg is covered by the
+    live gate and the stale-table probes below)."""
+
+    def _header(self, name, producers, consumers, retired=False):
+        from tools.pstpu_lint.http_registry import ProtocolHeader
+
+        return ProtocolHeader(name, "request", tuple(producers),
+                              tuple(consumers), "shape", retired, "doc")
+
+    def _route(self, method, path, planes, debug=False, internal=False,
+               test_ref=None):
+        from tools.pstpu_lint.http_registry import Route
+
+        return Route(method, path, tuple(planes), debug, internal,
+                     test_ref, "doc")
+
+    # ------------------------------------------------------------- PL011
+    def test_registered_header_round_trip_is_clean(self, tmp_path):
+        from tools.pstpu_lint.rules.http_drift import check_headers
+
+        _write(tmp_path, "production_stack_tpu/router/proxy.py", """
+            def forward():
+                return {"x-pstpu-probe": "1"}
+        """)
+        _write(tmp_path, "production_stack_tpu/server/handlers.py", """
+            def read(request):
+                return request.headers.get("x-pstpu-probe")
+        """)
+        registry = (self._header("x-pstpu-probe", ("router",), ("engine",)),)
+        assert check_headers(str(tmp_path), registry_headers=registry,
+                             docs_check=False) == []
+
+    def test_unregistered_header_fires(self, tmp_path):
+        from tools.pstpu_lint.rules.http_drift import check_headers
+
+        _write(tmp_path, "production_stack_tpu/server/handlers.py", """
+            def build():
+                return {"x-pstpu-bogus": "1"}
+        """)
+        findings = check_headers(str(tmp_path), registry_headers=(),
+                                 docs_check=False)
+        assert _codes(findings) == ["PL011"]
+        assert "x-pstpu-bogus" in findings[0].message
+        assert "not in the HTTP registry" in findings[0].message
+        assert findings[0].file == "production_stack_tpu/server/handlers.py"
+        assert findings[0].line == 3
+
+    def test_mixed_case_literal_fires_once(self, tmp_path):
+        from tools.pstpu_lint.rules.http_drift import check_headers
+
+        _write(tmp_path, "production_stack_tpu/server/handlers.py", """
+            def read(request):
+                return request.headers.get("X-Pstpu-Probe")
+        """)
+        registry = (self._header("x-pstpu-probe", ("external",),
+                                 ("engine",)),)
+        findings = check_headers(str(tmp_path), registry_headers=registry,
+                                 docs_check=False)
+        # Exactly one finding: the .get() arg is also a child of the Call
+        # node, and the per-line dedupe must not double-report it.
+        assert _codes(findings) == ["PL011"]
+        assert "mixed-case" in findings[0].message
+        assert "'x-pstpu-probe'" in findings[0].message
+
+    def test_missing_consumer_plane_fires(self, tmp_path):
+        from tools.pstpu_lint.rules.http_drift import check_headers
+
+        _write(tmp_path, "production_stack_tpu/router/proxy.py", """
+            def forward():
+                return {"x-pstpu-probe": "1"}
+        """)
+        _write(tmp_path, "production_stack_tpu/server/handlers.py", """
+            def read(request):
+                return None
+        """)
+        registry = (self._header("x-pstpu-probe", ("router",), ("engine",)),)
+        findings = check_headers(str(tmp_path), registry_headers=registry,
+                                 docs_check=False)
+        assert _codes(findings) == ["PL011"]
+        assert "no site in that plane reads it" in findings[0].message
+        assert findings[0].file == "tools/pstpu_lint/http_registry.py"
+
+    def test_missing_producer_plane_fires(self, tmp_path):
+        from tools.pstpu_lint.rules.http_drift import check_headers
+
+        _write(tmp_path, "production_stack_tpu/server/handlers.py", """
+            def read(request):
+                return request.headers.get("x-pstpu-probe")
+        """)
+        registry = (self._header("x-pstpu-probe", ("router",), ("engine",)),)
+        findings = check_headers(str(tmp_path), registry_headers=registry,
+                                 docs_check=False)
+        assert _codes(findings) == ["PL011"]
+        assert "no site in that plane sets it" in findings[0].message
+
+    def test_symbol_resolution_across_modules(self, tmp_path):
+        # RESUME_HEADER-style shared constants: declared in one module,
+        # produced and consumed by symbol name on different planes.
+        from tools.pstpu_lint.rules.http_drift import check_headers
+
+        _write(tmp_path, "production_stack_tpu/server/consts.py", """
+            PROBE_HEADER = "x-pstpu-probe"
+        """)
+        _write(tmp_path, "production_stack_tpu/router/proxy.py", """
+            from production_stack_tpu.server.consts import PROBE_HEADER
+
+            def forward(headers):
+                headers[PROBE_HEADER] = "1"
+        """)
+        _write(tmp_path, "production_stack_tpu/server/handlers.py", """
+            from production_stack_tpu.server.consts import PROBE_HEADER
+
+            def read(request):
+                return request.headers.get(PROBE_HEADER)
+        """)
+        registry = (self._header("x-pstpu-probe", ("router",), ("engine",)),)
+        assert check_headers(str(tmp_path), registry_headers=registry,
+                             docs_check=False) == []
+
+    def test_retired_header_reference_fires(self, tmp_path):
+        from tools.pstpu_lint.rules.http_drift import check_headers
+
+        path = _write(tmp_path, "production_stack_tpu/server/handlers.py",
+                      """
+            def read(request):
+                return request.headers.get("x-pstpu-old")
+        """)
+        registry = (self._header("x-pstpu-old", (), (), retired=True),)
+        findings = check_headers(str(tmp_path), registry_headers=registry,
+                                 docs_check=False)
+        assert _codes(findings) == ["PL011"]
+        assert "retired" in findings[0].message
+
+        # A lingering declaration alone is fine (the constant may stay
+        # for migration tooling); only live references fire.
+        path.write_text('OLD_HEADER = "x-pstpu-old"\n')
+        assert check_headers(str(tmp_path), registry_headers=registry,
+                             docs_check=False) == []
+
+    def test_docstring_mention_is_not_a_site(self, tmp_path):
+        from tools.pstpu_lint.rules.http_drift import check_headers
+
+        _write(tmp_path, "production_stack_tpu/server/handlers.py", '''
+            """Speaks "x-pstpu-bogus" in prose only."""
+        ''')
+        assert check_headers(str(tmp_path), registry_headers=(),
+                             docs_check=False) == []
+
+    def test_payload_key_missing_fires(self, tmp_path):
+        from tools.pstpu_lint.rules.http_drift import check_headers
+
+        # A registered pstpu-payload consumer that stopped speaking one
+        # of the keys: the chunk shape drifted.
+        _write(tmp_path, "production_stack_tpu/router/sse.py", """
+            def parse(chunk):
+                state = chunk.get("pstpu", {})
+                return state.get("toks", []), state.get("off", 0)
+        """)
+        findings = check_headers(str(tmp_path), registry_headers=(),
+                                 docs_check=False)
+        assert _codes(findings) == ["PL011"]
+        assert "'seed'" in findings[0].message
+        assert findings[0].file == "production_stack_tpu/router/sse.py"
+
+    # ------------------------------------------------------------- PL012
+    def test_registered_route_is_clean(self, tmp_path):
+        from tools.pstpu_lint.rules.http_drift import check_routes
+
+        _write(tmp_path, "production_stack_tpu/router/app.py", """
+            def build_app(app, h):
+                app.router.add_post("/v1/probe", h)
+        """)
+        _write(tmp_path, "tests/test_probe.py", """
+            URL = "/v1/probe"
+        """)
+        registry = (self._route("POST", "/v1/probe", ("router",)),)
+        assert check_routes(str(tmp_path), registry_routes=registry,
+                            docs_check=False) == []
+
+    def test_unregistered_route_fires(self, tmp_path):
+        from tools.pstpu_lint.rules.http_drift import check_routes
+
+        _write(tmp_path, "production_stack_tpu/router/app.py", """
+            def build_app(app, h):
+                app.router.add_get("/v1/bogus", h)
+        """)
+        findings = check_routes(str(tmp_path), registry_routes=(),
+                                docs_check=False)
+        assert _codes(findings) == ["PL012"]
+        assert "GET /v1/bogus" in findings[0].message
+        assert "not in the HTTP registry" in findings[0].message
+        assert findings[0].file == "production_stack_tpu/router/app.py"
+        assert findings[0].line == 3
+
+    def test_unserved_registered_route_fires(self, tmp_path):
+        from tools.pstpu_lint.rules.http_drift import check_routes
+
+        _write(tmp_path, "production_stack_tpu/router/app.py", """
+            def build_app(app, h):
+                pass
+        """)
+        _write(tmp_path, "tests/test_probe.py", 'URL = "/v1/probe"\n')
+        registry = (self._route("POST", "/v1/probe", ("router",)),)
+        findings = check_routes(str(tmp_path), registry_routes=registry,
+                                docs_check=False)
+        assert _codes(findings) == ["PL012"]
+        assert "not served by the 'router' plane" in findings[0].message
+
+    def test_debug_route_outside_gate_fires(self, tmp_path):
+        from tools.pstpu_lint.rules.http_drift import check_routes
+
+        _write(tmp_path, "production_stack_tpu/server/api_server.py", """
+            def build_app(self, app):
+                app.router.add_get("/debug/probe", self.h)
+        """)
+        _write(tmp_path, "tests/test_probe.py", 'URL = "/debug/probe"\n')
+        registry = (self._route("GET", "/debug/probe", ("engine",),
+                                debug=True),)
+        findings = check_routes(str(tmp_path), registry_routes=registry,
+                                docs_check=False)
+        assert _codes(findings) == ["PL012"]
+        assert "debug_endpoints" in findings[0].message
+
+        # Behind the gate it is clean — and the inverse (an always-on
+        # route served under the gate) fires the other direction.
+        _write(tmp_path, "production_stack_tpu/server/api_server.py", """
+            def build_app(self, app):
+                if self.engine.config.debug_endpoints:
+                    app.router.add_get("/debug/probe", self.h)
+        """)
+        assert check_routes(str(tmp_path), registry_routes=registry,
+                            docs_check=False) == []
+        always_on = (self._route("GET", "/debug/probe", ("engine",)),)
+        findings = check_routes(str(tmp_path), registry_routes=always_on,
+                                docs_check=False)
+        assert _codes(findings) == ["PL012"]
+        assert "registered as always-on" in findings[0].message
+
+    def test_untested_route_fires_and_internal_is_exempt(self, tmp_path):
+        from tools.pstpu_lint.rules.http_drift import check_routes
+
+        _write(tmp_path, "production_stack_tpu/router/app.py", """
+            def build_app(app, h):
+                app.router.add_post("/v1/probe", h)
+        """)
+        _write(tmp_path, "tests/test_other.py", 'X = 1\n')
+        registry = (self._route("POST", "/v1/probe", ("router",)),)
+        findings = check_routes(str(tmp_path), registry_routes=registry,
+                                docs_check=False)
+        assert _codes(findings) == ["PL012"]
+        assert "referenced by no file under tests/" in findings[0].message
+
+        internal = (self._route("POST", "/v1/probe", ("router",),
+                                internal=True),)
+        assert check_routes(str(tmp_path), registry_routes=internal,
+                            docs_check=False) == []
+
+    # ------------------------------------------------------------- PL013
+    def test_503_with_retry_after_is_clean(self, tmp_path):
+        from tools.pstpu_lint.rules.http_drift import check_status
+
+        _write(tmp_path, "production_stack_tpu/server/handlers.py", """
+            from aiohttp import web
+
+            def shed():
+                return web.json_response(
+                    {"status": "shedding"}, status=503,
+                    headers={"Retry-After": "1"},
+                )
+        """)
+        assert check_status(str(tmp_path), docs_check=False) == []
+
+    def test_503_without_retry_after_fires(self, tmp_path):
+        from tools.pstpu_lint.rules.http_drift import check_status
+
+        _write(tmp_path, "production_stack_tpu/server/handlers.py", """
+            from aiohttp import web
+
+            def shed():
+                return web.json_response({"status": "shedding"}, status=503)
+        """)
+        findings = check_status(str(tmp_path), docs_check=False)
+        assert _codes(findings) == ["PL013"]
+        assert "'retry-after'" in findings[0].message
+        assert findings[0].line == 5
+
+    def test_error_helper_503_fires(self, tmp_path):
+        from tools.pstpu_lint.rules.http_drift import check_status
+
+        _write(tmp_path, "production_stack_tpu/server/handlers.py", """
+            def shed(_error):
+                return _error(503, "queue full")
+        """)
+        findings = check_status(str(tmp_path), docs_check=False)
+        assert _codes(findings) == ["PL013"]
+        assert "503" in findings[0].message
+
+    def test_server_emitting_client_marker_fires(self, tmp_path):
+        from tools.pstpu_lint.rules.http_drift import check_status
+
+        _write(tmp_path, "production_stack_tpu/router/app.py", """
+            from aiohttp import web
+
+            def nope():
+                return web.Response(status=599)
+        """)
+        findings = check_status(str(tmp_path), docs_check=False)
+        assert _codes(findings) == ["PL013"]
+        assert "client-side" in findings[0].message
+
+        # The bench plane OWNS the 599 marker — same code there is clean.
+        _write(tmp_path, "production_stack_tpu/router/app.py", "X = 1\n")
+        _write(tmp_path, "benchmarks/client.py", """
+            def mark_truncated(record):
+                record["status"] = 599
+                return record
+        """)
+        assert check_status(str(tmp_path), docs_check=False) == []
+
+    def test_unregistered_status_fires(self, tmp_path):
+        from tools.pstpu_lint.rules.http_drift import check_status
+
+        _write(tmp_path, "production_stack_tpu/server/handlers.py", """
+            from aiohttp import web
+
+            def teapot():
+                return web.json_response({}, status=418)
+        """)
+        findings = check_status(str(tmp_path), docs_check=False)
+        assert _codes(findings) == ["PL013"]
+        assert "418" in findings[0].message
+        assert "not in the HTTP registry" in findings[0].message
+
+    def test_dynamic_sites_are_out_of_scope(self, tmp_path):
+        # Non-literal headers kwarg: unverifiable, treated as satisfied.
+        # Non-constant status (the fake engine's fault injection): skipped.
+        from tools.pstpu_lint.rules.http_drift import check_status
+
+        _write(tmp_path, "production_stack_tpu/server/handlers.py", """
+            from aiohttp import web
+
+            def shed(hdrs):
+                return web.json_response({}, status=503, headers=hdrs)
+
+            def fault(self):
+                return web.json_response({}, status=self.unavailable_status)
+        """)
+        assert check_status(str(tmp_path), docs_check=False) == []
+
+
 # ------------------------------------------------------------ PL006 helm leg
 class TestHelmDrift:
     def _chart(self, tmp_path, flag="--num-decode-steps",
@@ -1263,10 +1616,12 @@ class TestLiveRepo:
 
     def test_docs_tables_are_fresh(self):
         """docs/METRICS.md + the focused tables + README flag tables +
-        docs/WIRE_FORMATS.md match the registries (regenerate with
+        docs/WIRE_FORMATS.md + docs/HTTP_PROTOCOL.md (and the status/
+        resume tables it feeds) match the registries (regenerate with
         python -m tools.pstpu_lint.gen_docs)."""
         from tools.pstpu_lint.gen_docs import (
             check_flag_tables,
+            check_http_tables,
             check_tables,
             check_wire_tables,
         )
@@ -1274,6 +1629,7 @@ class TestLiveRepo:
         assert check_tables(REPO) == []
         assert check_flag_tables(REPO) == []
         assert check_wire_tables(REPO) == []
+        assert check_http_tables(REPO) == []
 
     def test_stale_wire_table_fails_pl010(self, tmp_path):
         """The PL010 docs-freshness gate, PL004-style: a WIRE_FORMATS.md
@@ -1309,14 +1665,26 @@ class TestLiveRepo:
 
 
 class TestLiveRepoInjections:
-    """The four acceptance probes: each hazard injected into a COPY of the
+    """The acceptance probes: each hazard injected into a COPY of the
     real source must fail the suite with a correct file/line github
     annotation. These guard the analyzers themselves — a rule that
     silently stops firing on the real tree's idioms fails here."""
 
+    # Everything the HTTP drift rules scan: sources + the test-reference
+    # corpus + the registry (finding anchors) + the generated docs.
+    HTTP_DIRS = ("production_stack_tpu", "benchmarks", "tests", "tools",
+                 "docs")
+
     def _copy(self, tmp_path, rel):
         src = open(os.path.join(REPO, rel)).read()
         return src, tmp_path / rel
+
+    def _http_tree(self, tmp_path):
+        import shutil
+
+        for rel in self.HTTP_DIRS:
+            shutil.copytree(os.path.join(REPO, rel), tmp_path / rel,
+                            ignore=shutil.ignore_patterns("__pycache__"))
 
     def _annotations(self, findings):
         return [f.render("github") for f in findings]
@@ -1433,3 +1801,101 @@ class TestLiveRepoInjections:
         # Control: the pristine copy is clean.
         serde.write_text(src)
         assert check_wire(str(tmp_path), docs_check=False) == []
+
+    def test_bogus_header_in_request_service(self, tmp_path):
+        """(e) an unregistered x-pstpu-* header set in a copy of the
+        router's proxy path fires PL011 at the injected line."""
+        from tools.pstpu_lint.rules.http_drift import check_headers
+
+        self._http_tree(tmp_path)
+        rel = "production_stack_tpu/router/request_service.py"
+        path = tmp_path / rel
+        src = path.read_text()
+        needle = ('    headers[DISAGG_FALLBACK_HEADER] = "1"\n'
+                  '    headers[RESUME_HEADER] = "1"\n')
+        assert src.count(needle) == 1, "resume header synthesis moved"
+        path.write_text(src.replace(
+            needle, needle + '    headers["x-pstpu-bogus"] = "1"\n'))
+        line = src[:src.index(needle)].count("\n") + 3
+        findings = check_headers(str(tmp_path), docs_check=False)
+        assert _codes(findings) == ["PL011"]
+        assert findings[0].line == line
+        assert "x-pstpu-bogus" in findings[0].message
+        ann = self._annotations(findings)[0]
+        assert ann.startswith(f"::error file={rel},line={line},")
+
+        # Control: the pristine copy is clean.
+        path.write_text(src)
+        assert check_headers(str(tmp_path), docs_check=False) == []
+
+    def test_bogus_route_in_api_server(self, tmp_path):
+        """(f) an unregistered route registration in a copy of the engine
+        API server fires PL012 at the add_get line."""
+        from tools.pstpu_lint.rules.http_drift import check_routes
+
+        self._http_tree(tmp_path)
+        rel = "production_stack_tpu/server/api_server.py"
+        path = tmp_path / rel
+        src = path.read_text()
+        needle = '        app.router.add_get("/version", self.version)\n'
+        assert src.count(needle) == 1, "route table shape moved"
+        path.write_text(src.replace(
+            needle,
+            needle + '        app.router.add_get("/v1/bogus", self.version)\n'
+        ))
+        line = src[:src.index(needle)].count("\n") + 2
+        findings = check_routes(str(tmp_path), docs_check=False)
+        assert _codes(findings) == ["PL012"]
+        assert findings[0].line == line
+        assert "GET /v1/bogus" in findings[0].message
+        ann = self._annotations(findings)[0]
+        assert ann.startswith(f"::error file={rel},line={line},")
+
+        path.write_text(src)
+        assert check_routes(str(tmp_path), docs_check=False) == []
+
+    def test_retry_after_less_503_in_api_server(self, tmp_path):
+        """(g) stripping Retry-After from a real 503 emit site in a copy
+        of the engine API server fires PL013 at that site."""
+        from tools.pstpu_lint.rules.http_drift import check_status
+
+        self._http_tree(tmp_path)
+        rel = "production_stack_tpu/server/api_server.py"
+        path = tmp_path / rel
+        src = path.read_text()
+        needle = (
+            '            return _error(503, f"Profiler failed to start: '
+            '{e}",\n'
+            '                          etype="service_unavailable",\n'
+            '                          headers={"Retry-After": "1"})\n')
+        assert src.count(needle) == 1, "profiler 503 site moved"
+        path.write_text(src.replace(
+            needle,
+            '            return _error(503, f"Profiler failed to start: '
+            '{e}",\n'
+            '                          etype="service_unavailable")\n'))
+        line = src[:src.index(needle)].count("\n") + 1
+        findings = check_status(str(tmp_path), docs_check=False)
+        assert _codes(findings) == ["PL013"]
+        assert findings[0].line == line
+        assert "'retry-after'" in findings[0].message
+        ann = self._annotations(findings)[0]
+        assert ann.startswith(f"::error file={rel},line={line},")
+
+        path.write_text(src)
+        assert check_status(str(tmp_path), docs_check=False) == []
+
+    def test_stale_http_doc_fails_pl011(self, tmp_path):
+        """A doctored docs/HTTP_PROTOCOL.md headers table is a PL011
+        finding pointing at the docs file (the PL004-style freshness
+        gate for the HTTP tables)."""
+        from tools.pstpu_lint.rules.http_drift import check_headers
+
+        self._http_tree(tmp_path)
+        doc = tmp_path / "docs/HTTP_PROTOCOL.md"
+        doc.write_text(doc.read_text().replace(
+            "| `x-pstpu-resume` |", "| `x-pstpu-resumed` |"))
+        findings = check_headers(str(tmp_path))
+        assert _codes(findings) == ["PL011"]
+        assert "out of date" in findings[0].message
+        assert findings[0].file == "docs/HTTP_PROTOCOL.md"

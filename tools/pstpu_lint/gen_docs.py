@@ -4,15 +4,20 @@ single-source registries, inside marker comments:
     <!-- pstpu-metrics:BEGIN <group> -->  ...  <!-- pstpu-metrics:END <group> -->
     <!-- pstpu-flags:BEGIN <tier> -->     ...  <!-- pstpu-flags:END <tier> -->
     <!-- pstpu-wire:BEGIN <group> -->     ...  <!-- pstpu-wire:END <group> -->
+    <!-- pstpu-http:BEGIN <group> -->     ...  <!-- pstpu-http:END <group> -->
 
 Write mode refreshes the delimited blocks in place; ``--check`` reports
 stale/missing blocks without writing (the PL004 rule runs the metrics half
-of the check on every lint; PL010 the wire half). Sources of truth:
+of the check on every lint; PL010 the wire half; PL011-PL013 the http
+tables). Sources of truth:
 
   * series: tools/pstpu_lint/metrics_registry.py
   * flags:  the argparse definitions in router/parser.py and
             server/api_server.py (tools/pstpu_lint/flags.py scans them)
   * wire:   tools/pstpu_lint/wire_registry.py (docs/WIRE_FORMATS.md)
+  * http:   tools/pstpu_lint/http_registry.py (docs/HTTP_PROTOCOL.md,
+            plus the focused status table in docs/RESILIENCE.md and the
+            resume-header table in docs/ROUTER_SCALE.md)
 
 Usage: ``python -m tools.pstpu_lint.gen_docs [--check]``.
 """
@@ -52,6 +57,19 @@ FLAG_TABLES = {
 WIRE_TABLES = {
     "formats": "docs/WIRE_FORMATS.md",
     "ops": "docs/WIRE_FORMATS.md",
+}
+
+# http table group -> file carrying its marker block. The full catalogue
+# lives in docs/HTTP_PROTOCOL.md; "status-semantics" and "resume" are the
+# focused projections RESILIENCE.md and ROUTER_SCALE.md embed. PL011 owns
+# headers/payload/resume freshness, PL012 routes, PL013 the status pair.
+HTTP_TABLES = {
+    "headers": "docs/HTTP_PROTOCOL.md",
+    "routes": "docs/HTTP_PROTOCOL.md",
+    "status": "docs/HTTP_PROTOCOL.md",
+    "payload": "docs/HTTP_PROTOCOL.md",
+    "status-semantics": "docs/RESILIENCE.md",
+    "resume": "docs/ROUTER_SCALE.md",
 }
 
 _SURFACE_NAMES = {
@@ -128,6 +146,74 @@ def render_wire_table(group: str, formats=None, ops=None) -> str:
     return "\n".join(lines)
 
 
+def render_http_table(group: str, headers=None, routes=None,
+                      statuses=None) -> str:
+    from tools.pstpu_lint import http_registry as hreg
+
+    headers = hreg.HEADERS if headers is None else headers
+    routes = hreg.ROUTES if routes is None else routes
+    statuses = hreg.STATUS_CODES if statuses is None else statuses
+    if group == "headers":
+        lines = [
+            "| Header | Direction | Producers | Consumers | Value "
+            "| Status | Meaning |",
+            "|---|---|---|---|---|---|---|",
+        ]
+        for h in headers:
+            lines.append(
+                f"| `{h.name}` | {h.direction} "
+                f"| {', '.join(h.producers)} | {', '.join(h.consumers)} "
+                f"| {_cell(h.shape)} "
+                f"| {'retired' if h.retired else 'active'} "
+                f"| {_cell(h.doc)} |")
+        return "\n".join(lines)
+    if group == "routes":
+        lines = [
+            "| Method | Path | Planes | Debug-gated | Internal "
+            "| Meaning |",
+            "|---|---|---|---|---|---|",
+        ]
+        for r in routes:
+            lines.append(
+                f"| {r.method} | `{r.path}` | {', '.join(r.planes)} "
+                f"| {'yes' if r.debug else 'no'} "
+                f"| {'yes' if r.internal else 'no'} | {_cell(r.doc)} |")
+        return "\n".join(lines)
+    if group in ("status", "status-semantics"):
+        lines = [
+            "| Code | Type | Required response headers | Server-emitted "
+            "| Meaning |",
+            "|---|---|---|---|---|",
+        ]
+        for s in statuses:
+            companions = ", ".join(
+                f"`{c}`" for c in s.companions) or "—"
+            emitted = "yes" if s.server_emitted else "**never**"
+            lines.append(
+                f"| {s.code} | `{s.name}` | {companions} | {emitted} "
+                f"| {_cell(s.doc)} |")
+        return "\n".join(lines)
+    if group == "payload":
+        lines = [
+            "| Key | Type | Meaning |",
+            "|---|---|---|",
+        ]
+        for k in hreg.SSE_PAYLOAD_KEYS:
+            lines.append(f"| `{k.key}` | {k.shape} | {_cell(k.doc)} |")
+        return "\n".join(lines)
+    # "resume": the client->router cross-router resume header pair
+    # ROUTER_SCALE.md documents next to the reconnect walkthrough.
+    lines = [
+        "| Header | Value | Meaning |",
+        "|---|---|---|",
+    ]
+    for h in headers:
+        if h.name.startswith("x-pstpu-resume-"):
+            lines.append(
+                f"| `{h.name}` | {_cell(h.shape)} | {_cell(h.doc)} |")
+    return "\n".join(lines)
+
+
 def _block_re(kind: str, group: str) -> re.Pattern:
     return re.compile(
         rf"(<!-- pstpu-{kind}:BEGIN {re.escape(group)} -->)\n"
@@ -150,11 +236,14 @@ def _update_block(text: str, kind: str, group: str,
 
 
 def _iter_blocks(project_root: str, registry=None, kinds=None,
-                 wire_registries=None):
+                 wire_registries=None, http_registries=None,
+                 http_groups=None):
     """Every generated block as (kind, group, relpath, path, table-or-None);
     table is None when an input file is missing. ``kinds`` restricts which
     table families are rendered (PL004 checks only the metrics tables,
-    PL006 only the flag tables — no point rendering the other half)."""
+    PL006 only the flag tables — no point rendering the other half);
+    ``http_groups`` further restricts the http family (each of
+    PL011-PL013 owns a subset of its tables)."""
     if kinds is None or "metrics" in kinds:
         for group, relpath in TABLES.items():
             path = os.path.join(project_root, relpath)
@@ -176,12 +265,22 @@ def _iter_blocks(project_root: str, registry=None, kinds=None,
             table = (render_wire_table(group, **(wire_registries or {}))
                      if os.path.exists(path) else None)
             yield "wire", group, relpath, path, table
+    if kinds is None or "http" in kinds:
+        for group, relpath in HTTP_TABLES.items():
+            if http_groups is not None and group not in http_groups:
+                continue
+            path = os.path.join(project_root, relpath)
+            table = (render_http_table(group, **(http_registries or {}))
+                     if os.path.exists(path) else None)
+            yield "http", group, relpath, path, table
 
 
 def _sync_blocks(project_root: str, registry=None,
                  write: bool = False,
                  kinds=None,
-                 wire_registries=None) -> List[Tuple[str, str, str]]:
+                 wire_registries=None,
+                 http_registries=None,
+                 http_groups=None) -> List[Tuple[str, str, str]]:
     """One pass over every block. write=False: report (group, relpath,
     problem) per stale/missing block. write=True: refresh stale blocks in
     place and report (group, relpath, "updated") per file written —
@@ -189,7 +288,8 @@ def _sync_blocks(project_root: str, registry=None,
     ``gen_docs`` and ``gen_docs --check`` can never disagree on a tree."""
     out = []
     for kind, group, relpath, path, table in _iter_blocks(
-        project_root, registry, kinds, wire_registries
+        project_root, registry, kinds, wire_registries,
+        http_registries, http_groups
     ):
         if table is None:
             out.append((group, relpath, "missing (file not found)"))
@@ -229,6 +329,20 @@ def check_wire_tables(project_root: str, formats=None,
     return _sync_blocks(project_root, kinds={"wire"}, wire_registries=wire)
 
 
+def check_http_tables(project_root: str, groups=None, headers=None,
+                      routes=None, statuses=None
+                      ) -> List[Tuple[str, str, str]]:
+    """(group, relpath, problem) for every stale/missing http block
+    (the PL011-PL013 docs-freshness gates; ``groups`` restricts to the
+    calling rule's tables)."""
+    http = None
+    if headers is not None or routes is not None or statuses is not None:
+        http = {"headers": headers, "routes": routes,
+                "statuses": statuses}
+    return _sync_blocks(project_root, kinds={"http"},
+                        http_registries=http, http_groups=groups)
+
+
 def write_tables(project_root: str) -> List[str]:
     """Refresh every block in place; returns the files touched (and raises
     nothing on missing files — they surface via --check / PL004)."""
@@ -249,7 +363,7 @@ def main(argv=None) -> int:
     root = os.path.abspath(args.project_root)
     if args.check:
         problems = (check_tables(root) + check_flag_tables(root)
-                    + check_wire_tables(root))
+                    + check_wire_tables(root) + check_http_tables(root))
         for group, relpath, what in problems:
             print(f"{relpath}: table {group!r} is {what}", file=sys.stderr)
         return 1 if problems else 0

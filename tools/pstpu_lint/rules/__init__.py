@@ -14,6 +14,7 @@ from tools.pstpu_lint.rules import (
     blocked_event_loop,
     fire_and_forget,
     flag_drift,
+    http_drift,
     metrics_drift,
     shared_state_race,
     swallowed_exceptions,
@@ -58,4 +59,9 @@ PROJECT_RULES = [
     ("PL004", metrics_drift.wants, metrics_drift.check),
     ("PL006", flag_drift.wants, flag_drift.check),
     ("PL010", wire_drift.wants, wire_drift.check),
+    # The HTTP control surface (tools/pstpu_lint/http_registry.py): one
+    # registry, three families — headers, routes, status semantics.
+    ("PL011", http_drift.wants, http_drift.check_headers),
+    ("PL012", http_drift.wants, http_drift.check_routes),
+    ("PL013", http_drift.wants, http_drift.check_status),
 ]

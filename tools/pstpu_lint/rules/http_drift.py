@@ -1,0 +1,653 @@
+"""PL011/PL012/PL013 — HTTP control-surface drift.
+
+The router proxies, resumes, and re-routes against engine endpoints over
+a private protocol: ``x-pstpu-*``/``x-slo-*``/``x-ttft-*``/``x-request-*``
+headers, internal routes, shed-vs-error status semantics, and the
+``pstpu`` SSE chunk payload. All of it is string literals spread over
+three server implementations and two client harnesses — exactly the
+cross-process drift class PL004 (metrics) and PL010 (wire magics) closed
+for the other planes. Everything is checked against
+``tools/pstpu_lint/http_registry.py``:
+
+PL011 — header drift:
+  1. every literal shaped like a claimed prefix must be a registered
+     header (or an exact namespace filter such as ``"x-pstpu-"``);
+  2. header literals are lowercase (aiohttp lookups are case-insensitive,
+     greps are not);
+  3. per scanned plane the registry names: every producer plane has a
+     producing site (dict-literal key, ``headers[h] = ...``) and every
+     consumer plane a consuming site (``.get``/``.pop``/``in``) — a
+     header set by the router but read nowhere on the engine is drift;
+  4. retired headers appear nowhere in code;
+  5. the ``pstpu`` SSE payload keys (``toks``/``off``/``seed``) appear in
+     every emitter and consumer file;
+  6. the generated headers/payload/resume tables are fresh.
+
+PL012 — route drift: every ``app.router.add_*`` registration is in the
+registry for its plane and vice versa (the fake engine's parity with the
+real engine rides on this); debug-gated routes sit behind the
+``debug_endpoints`` config check and only those; every non-internal route
+is referenced by at least one file under ``tests/``; routes table fresh.
+
+PL013 — status-code semantics: every constant-status emit site
+(``_error(<code>, ...)``, ``json_response(..., status=<code>)``,
+``web.Response(status=<code>)``) in the server planes uses a registered
+4xx/5xx code, carries the registry's companion headers (a 503 without
+``Retry-After`` is indistinguishable from an outage — the soak
+accounting and honor-retry-after clients key on it), and never emits a
+client-side marker code (599); status tables fresh.
+
+Constants are resolved project-wide (``RESUME_HEADER`` is declared in
+``disagg/transfer.py`` and used on both planes), and one level of local
+helper-call flow counts as consumption (``Deadline._header_float``).
+Non-constant status expressions are out of scope by design — the fake
+engine's fault-injected ``self.unavailable_status`` stays checkable by
+its tests, not statically.
+"""
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.pstpu_lint import http_registry as reg
+from tools.pstpu_lint.core import Finding
+
+SCAN_DIRS = ("production_stack_tpu", "benchmarks")
+EXTRA_FILES = ("tests/fake_engine.py",)
+REGISTRY_FILE = "tools/pstpu_lint/http_registry.py"
+
+# plane -> the file whose route table it owns
+ROUTE_FILES = (
+    ("engine", "production_stack_tpu/server/api_server.py"),
+    ("router", "production_stack_tpu/router/app.py"),
+    ("fake", "tests/fake_engine.py"),
+)
+_ADD_METHODS = {"add_get": "GET", "add_post": "POST", "add_put": "PUT",
+                "add_delete": "DELETE", "add_patch": "PATCH"}
+_GETTER_ATTRS = {"get", "getall", "getone", "pop"}
+_STATUS_CALLEES = {"json_response", "Response", "HTTPException"}
+
+
+def _plane_of(relpath: str) -> Optional[str]:
+    if relpath in EXTRA_FILES:
+        return "fake"
+    if relpath.startswith("production_stack_tpu/router"):
+        return "router"
+    if relpath.startswith("benchmarks"):
+        return "bench"
+    if relpath.startswith("production_stack_tpu"):
+        return "engine"
+    return None
+
+
+def _iter_py(project_root: str):
+    for rel_dir in SCAN_DIRS:
+        root = os.path.join(project_root, rel_dir)
+        if not os.path.isdir(root):
+            continue
+        for dirpath, dirs, files in os.walk(root):
+            dirs[:] = [d for d in dirs if d != "__pycache__"]
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    path = os.path.join(dirpath, name)
+                    rel = os.path.relpath(path, project_root).replace(
+                        os.sep, "/")
+                    yield rel, path
+    for rel in EXTRA_FILES:
+        path = os.path.join(project_root, rel)
+        if os.path.exists(path):
+            yield rel, path
+
+
+def _parse(path: str) -> Optional[ast.Module]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            return ast.parse(f.read())
+    except (SyntaxError, OSError):
+        return None   # PL000 owns unparseable files
+
+
+def _registry_line(project_root: str, needle: str) -> Tuple[str, int]:
+    """Anchor registry-level findings to the entry (or line 1) of the
+    registry module — that is where the fix or the decision belongs."""
+    path = os.path.join(project_root, REGISTRY_FILE)
+    try:
+        with open(path, encoding="utf-8") as f:
+            for i, line in enumerate(f, 1):
+                if f'"{needle}"' in line:
+                    return REGISTRY_FILE, i
+    except OSError:
+        pass
+    return REGISTRY_FILE, 1
+
+
+def _docstring_constants(tree: ast.Module) -> Set[int]:
+    """id()s of Constant nodes that are docstrings/bare-string
+    statements — header names in prose are not protocol sites."""
+    out: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Expr) and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str):
+            out.add(id(node.value))
+    return out
+
+
+def _is_claimed(text: str, prefixes) -> bool:
+    low = text.lower()
+    return (any(low.startswith(p) for p in prefixes)
+            and " " not in text and "\n" not in text
+            and all(c.isalnum() or c == "-" for c in low))
+
+
+# --------------------------------------------------------------- PL011
+
+
+def _header_symbols(project_root: str, headers_by_name, prefixes
+                    ) -> Dict[str, str]:
+    """Project-wide constant table: symbol name -> lowercase header, from
+    ``NAME = "x-..."`` assignments and annotated (class) fields. Header
+    constants are shared across planes by import (``RESUME_HEADER`` lives
+    in disagg/transfer.py), so resolution is by name, not by module."""
+    table: Dict[str, str] = {}
+    for _rel, path in _iter_py(project_root):
+        tree = _parse(path)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            target = None
+            value = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                target, value = node.targets[0].id, node.value
+            elif isinstance(node, ast.AnnAssign) and \
+                    isinstance(node.target, ast.Name):
+                target, value = node.target.id, node.value
+            if target is None or not isinstance(value, ast.Constant) \
+                    or not isinstance(value.value, str):
+                continue
+            if _is_claimed(value.value, prefixes):
+                table[target] = value.value.lower()
+    return table
+
+
+def _local_getter_params(tree: ast.Module) -> Dict[str, Set[int]]:
+    """function name -> parameter indices that flow into a ``.get(...)``
+    inside its body (one level: ``Deadline._header_float``)."""
+    out: Dict[str, Set[int]] = {}
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        params = [a.arg for a in fn.args.args]
+        for call in ast.walk(fn):
+            if isinstance(call, ast.Call) and \
+                    isinstance(call.func, ast.Attribute) and \
+                    call.func.attr in _GETTER_ATTRS and call.args and \
+                    isinstance(call.args[0], ast.Name) and \
+                    call.args[0].id in params:
+                out.setdefault(fn.name, set()).add(
+                    params.index(call.args[0].id))
+    return out
+
+
+class _HeaderUses(ast.NodeVisitor):
+    """Classify every reference to a protocol header as producing
+    (dict-literal key, subscript store), consuming (.get/.pop/``in``,
+    subscript load, flow into a local getter helper), declaring (the
+    constant/field definition itself), or a bare mention."""
+
+    def __init__(self, symbols: Dict[str, str], getter_params,
+                 skip_constants: Set[int], prefixes):
+        self.symbols = symbols
+        self.getter_params = getter_params
+        self.skip = skip_constants
+        self.prefixes = prefixes
+        # (lowercase header, kind, line, raw literal or None)
+        self.refs: List[Tuple[str, str, int, Optional[str]]] = []
+
+    def _header_of(self, node) -> Optional[Tuple[str, Optional[str]]]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and id(node) not in self.skip \
+                and _is_claimed(node.value, self.prefixes):
+            return node.value.lower(), node.value
+        if isinstance(node, ast.Name) and node.id in self.symbols:
+            return self.symbols[node.id], None
+        if isinstance(node, ast.Attribute) and node.attr in self.symbols:
+            return self.symbols[node.attr], None
+        return None
+
+    def _emit(self, node, kind: str):
+        got = self._header_of(node)
+        if got is not None:
+            self.refs.append((got[0], kind, node.lineno, got[1]))
+            return True
+        return False
+
+    def visit_Assign(self, node: ast.Assign):
+        if len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                self._emit(node.value, "declare"):
+            return
+        for t in node.targets:
+            if isinstance(t, ast.Subscript):
+                self._emit(t.slice, "produce")
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None and self._emit(node.value, "declare"):
+            return
+        self.generic_visit(node)
+
+    def visit_Dict(self, node: ast.Dict):
+        for key in node.keys:
+            if key is not None:
+                self._emit(key, "produce")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _GETTER_ATTRS and node.args:
+            self._emit(node.args[0], "consume")
+        elif isinstance(node.func, ast.Name) and \
+                node.func.id in self.getter_params:
+            indices = self.getter_params[node.func.id]
+            for i, arg in enumerate(node.args):
+                if i in indices:
+                    self._emit(arg, "consume")
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare):
+        if any(isinstance(op, (ast.In, ast.NotIn)) for op in node.ops):
+            self._emit(node.left, "consume")
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript):
+        if isinstance(node.ctx, ast.Load):
+            self._emit(node.slice, "consume")
+        self.generic_visit(node)
+
+    def generic_visit(self, node: ast.AST):
+        for child in ast.iter_child_nodes(node):
+            got = self._header_of(child)
+            if got is not None:
+                self.refs.append((got[0], "mention", child.lineno, got[1]))
+        super().generic_visit(node)
+
+
+def check_headers(project_root: str, registry_headers=None,
+                  docs_check: bool = True) -> List[Finding]:
+    headers = reg.HEADERS if registry_headers is None else registry_headers
+    by_name = {h.name: h for h in headers}
+    prefixes = reg.CLAIMED_PREFIXES
+    findings: List[Finding] = []
+    symbols = _header_symbols(project_root, by_name, prefixes)
+    # evidence[header][plane] = set of use kinds observed
+    evidence: Dict[str, Dict[str, Set[str]]] = {}
+    flagged: Set[Tuple[str, str]] = set()
+
+    for rel, path in _iter_py(project_root):
+        plane = _plane_of(rel)
+        tree = _parse(path)
+        if tree is None or plane is None:
+            continue
+        uses = _HeaderUses(symbols, _local_getter_params(tree),
+                           _docstring_constants(tree), prefixes)
+        uses.visit(tree)
+        # A literal can be classified twice (e.g. a .get() arg is also a
+        # direct child of the Call) — per-line dedupe keeps one finding
+        # per actual source site.
+        seen_case: Set[Tuple[int, str]] = set()
+        seen_retired: Set[Tuple[int, str]] = set()
+        for name, kind, line, raw in uses.refs:
+            if raw is not None and raw != raw.lower() and \
+                    (line, raw) not in seen_case:
+                seen_case.add((line, raw))
+                findings.append(Finding(
+                    "PL011", rel, line,
+                    f"mixed-case header literal {raw!r} — aiohttp lookups "
+                    f"are case-insensitive but greps and dict keys are "
+                    f"not; write {raw.lower()!r}"))
+            if name in reg.HEADER_NAMESPACES:
+                continue   # namespace filter site ("x-pstpu-" strip/fwd)
+            entry = by_name.get(name)
+            if entry is None:
+                if (rel, name) not in flagged:
+                    flagged.add((rel, name))
+                    findings.append(Finding(
+                        "PL011", rel, line,
+                        f"header {name!r} is not in the HTTP registry "
+                        f"(tools/pstpu_lint/http_registry.py) — every "
+                        f"protocol header needs a registered producer/"
+                        f"consumer contract"))
+                continue
+            if entry.retired and kind != "declare" and \
+                    (line, name) not in seen_retired:
+                seen_retired.add((line, name))
+                findings.append(Finding(
+                    "PL011", rel, line,
+                    f"header {name!r} is retired in the HTTP registry "
+                    f"but still referenced here"))
+            evidence.setdefault(name, {}).setdefault(plane, set()).add(kind)
+
+    for h in headers:
+        if h.retired:
+            continue
+        seen = evidence.get(h.name, {})
+        for plane in h.producers:
+            if plane in reg.SCANNED_PLANES and \
+                    "produce" not in seen.get(plane, set()):
+                rfile, rline = _registry_line(project_root, h.name)
+                findings.append(Finding(
+                    "PL011", rfile, rline,
+                    f"header {h.name!r} names {plane!r} as a producer "
+                    f"but no site in that plane sets it — drift between "
+                    f"the registry and the {plane} plane"))
+        for plane in h.consumers:
+            if plane in reg.SCANNED_PLANES and \
+                    "consume" not in seen.get(plane, set()):
+                rfile, rline = _registry_line(project_root, h.name)
+                findings.append(Finding(
+                    "PL011", rfile, rline,
+                    f"header {h.name!r} names {plane!r} as a consumer "
+                    f"but no site in that plane reads it — a header "
+                    f"nobody reads is dead protocol"))
+
+    findings.extend(_check_payload(project_root))
+    if docs_check:
+        findings.extend(_docs_findings(
+            project_root, groups={"headers", "payload", "resume"},
+            headers=registry_headers))
+    return findings
+
+
+def _check_payload(project_root: str) -> List[Finding]:
+    """Every pstpu SSE payload emitter/consumer file speaks the field
+    name and every registered key as string literals."""
+    findings: List[Finding] = []
+    wanted = [reg.SSE_PAYLOAD_FIELD] + [k.key for k in reg.SSE_PAYLOAD_KEYS]
+    for rel in reg.SSE_PAYLOAD_EMITTERS + reg.SSE_PAYLOAD_CONSUMERS:
+        path = os.path.join(project_root, rel)
+        if not os.path.exists(path):
+            continue
+        tree = _parse(path)
+        if tree is None:
+            continue
+        skip = _docstring_constants(tree)
+        literals = {n.value for n in ast.walk(tree)
+                    if isinstance(n, ast.Constant)
+                    and isinstance(n.value, str) and id(n) not in skip}
+        for key in wanted:
+            if key not in literals:
+                side = ("emitter" if rel in reg.SSE_PAYLOAD_EMITTERS
+                        else "consumer")
+                findings.append(Finding(
+                    "PL011", rel, 1,
+                    f"pstpu SSE payload {side} never mentions the "
+                    f"registered key {key!r} — the resume protocol's "
+                    f"chunk shape drifted (http_registry.SSE_PAYLOAD_*)"))
+    return findings
+
+
+# --------------------------------------------------------------- PL012
+
+
+class _RouteUses(ast.NodeVisitor):
+    """Collect (method, path, line, debug_gated) route registrations;
+    gating context is any enclosing ``if`` whose test mentions
+    ``debug_endpoints``."""
+
+    def __init__(self):
+        self.routes: List[Tuple[str, str, int, bool]] = []
+        self._gate_depth = 0
+
+    @staticmethod
+    def _mentions_debug_gate(test: ast.AST) -> bool:
+        for node in ast.walk(test):
+            if isinstance(node, ast.Attribute) and \
+                    node.attr == "debug_endpoints":
+                return True
+            if isinstance(node, ast.Name) and node.id == "debug_endpoints":
+                return True
+        return False
+
+    def visit_If(self, node: ast.If):
+        gated = self._mentions_debug_gate(node.test)
+        if gated:
+            self._gate_depth += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        if gated:
+            self._gate_depth -= 1
+        for stmt in node.orelse:
+            self.visit(stmt)
+
+    def visit_Call(self, node: ast.Call):
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _ADD_METHODS and node.args and \
+                isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str):
+            self.routes.append((
+                _ADD_METHODS[node.func.attr], node.args[0].value,
+                node.lineno, self._gate_depth > 0))
+        self.generic_visit(node)
+
+
+def _test_references(project_root: str) -> str:
+    """Concatenated text of every test file (fake_engine.py excluded —
+    a fake serving a route is not coverage of it)."""
+    chunks = []
+    tests = os.path.join(project_root, "tests")
+    if os.path.isdir(tests):
+        for dirpath, dirs, files in os.walk(tests):
+            dirs[:] = [d for d in dirs if d != "__pycache__"]
+            for name in sorted(files):
+                if name.endswith(".py") and name != "fake_engine.py":
+                    try:
+                        with open(os.path.join(dirpath, name),
+                                  encoding="utf-8") as f:
+                            chunks.append(f.read())
+                    except OSError:
+                        continue
+    return "\n".join(chunks)
+
+
+def check_routes(project_root: str, registry_routes=None,
+                 docs_check: bool = True) -> List[Finding]:
+    routes = reg.ROUTES if registry_routes is None else registry_routes
+    findings: List[Finding] = []
+    registered = {}   # (plane, method, path) -> Route
+    for r in routes:
+        for plane in r.planes:
+            registered[(plane, r.method, r.path)] = r
+
+    observed: Dict[Tuple[str, str, str], Tuple[int, bool]] = {}
+    for plane, rel in ROUTE_FILES:
+        path = os.path.join(project_root, rel)
+        if not os.path.exists(path):
+            continue
+        tree = _parse(path)
+        if tree is None:
+            continue
+        uses = _RouteUses()
+        uses.visit(tree)
+        for method, rpath, line, gated in uses.routes:
+            observed[(plane, method, rpath)] = (line, gated)
+            entry = registered.get((plane, method, rpath))
+            if entry is None:
+                findings.append(Finding(
+                    "PL012", rel, line,
+                    f"route {method} {rpath} is not in the HTTP registry "
+                    f"for the {plane!r} plane "
+                    f"(tools/pstpu_lint/http_registry.py)"))
+                continue
+            if gated and not entry.debug:
+                findings.append(Finding(
+                    "PL012", rel, line,
+                    f"route {method} {rpath} is registered as always-on "
+                    f"but served behind the debug_endpoints gate"))
+            elif entry.debug and not gated:
+                findings.append(Finding(
+                    "PL012", rel, line,
+                    f"route {method} {rpath} is registered as debug-only "
+                    f"but served unconditionally — debug surfaces must "
+                    f"sit behind the debug_endpoints config check"))
+
+    scanned_planes = {plane for plane, rel in ROUTE_FILES
+                      if os.path.exists(os.path.join(project_root, rel))}
+    route_files = dict(ROUTE_FILES)
+    for (plane, method, rpath), entry in registered.items():
+        if plane in scanned_planes and \
+                (plane, method, rpath) not in observed:
+            findings.append(Finding(
+                "PL012", route_files[plane], 1,
+                f"registered route {method} {rpath} is not served by the "
+                f"{plane!r} plane ({route_files[plane]}) — protocol "
+                f"parity drift"))
+
+    test_text = _test_references(project_root)
+    for r in routes:
+        if r.internal:
+            continue
+        needle = r.test_ref or r.path
+        if needle not in test_text:
+            rfile, rline = _registry_line(project_root, r.path)
+            findings.append(Finding(
+                "PL012", rfile, rline,
+                f"route {r.method} {r.path} is referenced by no file "
+                f"under tests/ — an untested surface drifts silently "
+                f"(mark internal=True only for plane-to-plane hops)"))
+
+    if docs_check:
+        findings.extend(_docs_findings(project_root, groups={"routes"},
+                                       routes=registry_routes))
+    return findings
+
+
+# --------------------------------------------------------------- PL013
+
+
+def _status_sites(tree: ast.Module):
+    """(code, headers-dict-keys or None, line) per constant-status emit
+    site. ``headers`` is None when absent and () when present but not a
+    literal dict (unverifiable — treated as satisfied)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        code = None
+        if isinstance(node.func, ast.Name) and node.func.id == "_error" \
+                and node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, int):
+            code = node.args[0].value
+        else:
+            callee = None
+            if isinstance(node.func, ast.Attribute):
+                callee = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                callee = node.func.id
+            if callee in _STATUS_CALLEES:
+                for kw in node.keywords:
+                    if kw.arg == "status" and \
+                            isinstance(kw.value, ast.Constant) and \
+                            isinstance(kw.value.value, int):
+                        code = kw.value.value
+        if code is None:
+            continue
+        header_keys = None
+        for kw in node.keywords:
+            if kw.arg == "headers":
+                if isinstance(kw.value, ast.Dict):
+                    header_keys = tuple(
+                        k.value.lower() for k in kw.value.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str))
+                else:
+                    header_keys = ()   # dynamic: can't verify statically
+        yield code, header_keys, node.lineno
+
+
+def check_status(project_root: str, registry_statuses=None,
+                 docs_check: bool = True) -> List[Finding]:
+    statuses = (reg.STATUS_CODES if registry_statuses is None
+                else registry_statuses)
+    by_code = {s.code: s for s in statuses}
+    findings: List[Finding] = []
+    for rel, path in _iter_py(project_root):
+        plane = _plane_of(rel)
+        if plane == "bench":
+            continue   # the client plane owns the 599 marker
+        tree = _parse(path)
+        if tree is None:
+            continue
+        for code, header_keys, line in _status_sites(tree):
+            if code < 400:
+                continue
+            entry = by_code.get(code)
+            if entry is None:
+                findings.append(Finding(
+                    "PL013", rel, line,
+                    f"status {code} is not in the HTTP registry — every "
+                    f"4xx/5xx the servers emit needs registered "
+                    f"semantics (tools/pstpu_lint/http_registry.py)"))
+                continue
+            if not entry.server_emitted:
+                findings.append(Finding(
+                    "PL013", rel, line,
+                    f"status {code} ({entry.name}) is a client-side "
+                    f"marker and must never be emitted by a server"))
+                continue
+            for companion in entry.companions:
+                if header_keys is None or (
+                        header_keys and companion not in header_keys):
+                    findings.append(Finding(
+                        "PL013", rel, line,
+                        f"status {code} ({entry.name}) requires a "
+                        f"{companion!r} response header — without it "
+                        f"clients cannot tell an intentional shed from "
+                        f"an outage (docs/RESILIENCE.md)"))
+    if docs_check:
+        findings.extend(_docs_findings(
+            project_root, groups={"status", "status-semantics"},
+            statuses=registry_statuses))
+    return findings
+
+
+# ------------------------------------------------------------ assembly
+
+
+def _docs_findings(project_root: str, groups, headers=None, routes=None,
+                   statuses=None) -> List[Finding]:
+    from tools.pstpu_lint.gen_docs import check_http_tables
+
+    rule = {"routes": "PL012", "status": "PL013",
+            "status-semantics": "PL013"}
+    return [
+        Finding(rule.get(group, "PL011"), relpath, 1,
+                f"generated HTTP table {group!r} is {what} — run "
+                f"python -m tools.pstpu_lint.gen_docs")
+        for group, relpath, what in check_http_tables(
+            project_root, groups=groups, headers=headers, routes=routes,
+            statuses=statuses)
+    ]
+
+
+def check_http(project_root: str, registry_headers=None,
+               registry_routes=None, registry_statuses=None,
+               docs_check: bool = True,
+               parts=("headers", "routes", "status")) -> List[Finding]:
+    """All three families in one pass (the tests' entry point)."""
+    findings: List[Finding] = []
+    if "headers" in parts:
+        findings.extend(check_headers(project_root, registry_headers,
+                                      docs_check))
+    if "routes" in parts:
+        findings.extend(check_routes(project_root, registry_routes,
+                                     docs_check))
+    if "status" in parts:
+        findings.extend(check_status(project_root, registry_statuses,
+                                     docs_check))
+    return findings
+
+
+def wants(project_root: str) -> bool:
+    return os.path.exists(os.path.join(
+        project_root, "production_stack_tpu/router/app.py"))
